@@ -1,0 +1,43 @@
+"""cylon_tpu — a TPU-native distributed relational data framework.
+
+A ground-up rebuild of the capabilities of Cylon (distributed-memory
+data-parallel relational tables; reference at /root/reference) designed for
+TPU hardware: Arrow-style columns live in TPU HBM as ``jax.Array``s sharded
+over a 1-D device mesh, the hash/range partition -> all-to-all shuffle ->
+local kernel pattern is expressed as jit + shard_map XLA programs with
+collectives over ICI/DCN, and local relational kernels (join, group-by, set
+ops, sort, unique, aggregates) are fused static-shape sort/segment programs
+instead of hash-table loops.
+
+Layer map (mirrors SURVEY.md §1):
+  L0 runtime   — context.py, status.py, dtypes.py, io/
+  L1 comm      — parallel/collectives.py (+ XLA)
+  L2 kernels   — ops/
+  L3 partition — parallel/partition.py, parallel/shuffle.py
+  L4 dist ops  — parallel/ops.py
+  L5 table API — table.py, column.py
+  L6 bindings  — frame.py (DataFrame), this package (PyCylon role)
+"""
+
+import jax as _jax
+
+# Arrow's default column types are 64-bit; a relational engine truncating
+# int64 keys is wrong, so x64 is enabled framework-wide.  Hot kernels cast
+# to TPU-friendly widths (uint32 hashes, int32 indices) explicitly.
+_jax.config.update("jax_enable_x64", True)
+
+from . import dtypes
+from .column import Column
+from .config import JoinAlgorithm, JoinConfig, JoinType, SortOptions
+from .context import CommType, CylonContext, LocalConfig, TPUConfig
+from .ops.groupby import AggOp
+from .status import Code, CylonError, Status
+from .table import Table
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Table", "Column", "CylonContext", "TPUConfig", "LocalConfig", "CommType",
+    "JoinConfig", "JoinType", "JoinAlgorithm", "SortOptions", "AggOp",
+    "Status", "Code", "CylonError", "dtypes", "__version__",
+]

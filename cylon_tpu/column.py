@@ -1,0 +1,240 @@
+"""Device-resident column.
+
+TPU-native analog of the reference's ``cylon::Column`` (reference:
+cpp/src/cylon/column.hpp:31-113) — a named, typed array — except that the
+backing store is ``jax.Array`` buffers in TPU HBM instead of an
+``arrow::ChunkedArray`` on the host heap.
+
+Representation choices (TPU-first):
+
+- Every column carries a static **capacity** (``data.shape[0]``); the number
+  of *valid* rows is tracked by the owning Table.  Padding rows beyond the
+  row count are zeroed.  This is what makes every relational kernel a
+  static-shape XLA program: ops produce a new capacity + a new dynamic row
+  count instead of dynamically-shaped arrays.
+- Nulls are a ``bool[capacity]`` validity vector (True = present), the JAX
+  rendering of Arrow's validity bitmap that the reference streams around
+  (reference: cpp/src/cylon/arrow/arrow_all_to_all.cpp:105-107).
+- STRING/BINARY columns are fixed-width padded byte matrices
+  ``uint8[capacity, width]`` plus ``int32[capacity]`` lengths — TPU kernels
+  need static shapes, so Arrow's offsets+bytes become pad-to-width on ingest
+  and are re-ragged only at the host boundary.  Zero padding preserves
+  bytewise lexicographic order, so sort/compare kernels can treat the byte
+  matrix as the value.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes
+from .dtypes import DataType, Type
+
+DEFAULT_STRING_WIDTH = 32
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class Column:
+    """One typed column of device buffers.
+
+    data:      [capacity] (fixed width) or [capacity, width] uint8 (strings)
+    validity:  bool[capacity]; True = value present
+    lengths:   int32[capacity] byte lengths (string-like only, else None)
+    dtype:     logical type (static / aux data for jit)
+    """
+
+    data: jax.Array
+    validity: jax.Array
+    lengths: Optional[jax.Array] = None
+    dtype: DataType = field(default=dtypes.int64, metadata={"static": True})
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def is_string(self) -> bool:
+        return dtypes.is_string_like(self.dtype)
+
+    @property
+    def string_width(self) -> int:
+        return int(self.data.shape[1]) if self.data.ndim == 2 else 0
+
+    def with_capacity(self, capacity: int) -> "Column":
+        """Pad (with zeros/False) or truncate buffers to a new capacity."""
+        cap = self.capacity
+        if capacity == cap:
+            return self
+        if capacity < cap:
+            return Column(self.data[:capacity], self.validity[:capacity],
+                          None if self.lengths is None else self.lengths[:capacity],
+                          self.dtype)
+        pad = capacity - cap
+        data = jnp.concatenate(
+            [self.data, jnp.zeros((pad,) + self.data.shape[1:], self.data.dtype)])
+        validity = jnp.concatenate([self.validity, jnp.zeros((pad,), bool)])
+        lengths = None
+        if self.lengths is not None:
+            lengths = jnp.concatenate([self.lengths, jnp.zeros((pad,), jnp.int32)])
+        return Column(data, validity, lengths, self.dtype)
+
+    def take(self, indices: jax.Array, valid_mask: Optional[jax.Array] = None) -> "Column":
+        """Gather rows by index; optionally AND validity with ``valid_mask``
+        (used by outer joins to null-fill non-matching rows, the analog of the
+        reference's -1 index fills, cpp/src/cylon/join/join.cpp:179-235)."""
+        data = jnp.take(self.data, indices, axis=0, mode="clip")
+        validity = jnp.take(self.validity, indices, axis=0, mode="clip")
+        if valid_mask is not None:
+            validity = validity & valid_mask
+            if not dtypes.is_string_like(self.dtype):
+                data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+            else:
+                data = jnp.where(validity[:, None], data, jnp.zeros((), data.dtype))
+        lengths = None
+        if self.lengths is not None:
+            lengths = jnp.take(self.lengths, indices, axis=0, mode="clip")
+            if valid_mask is not None:
+                lengths = jnp.where(validity, lengths, 0)
+        return Column(data, validity, lengths, self.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host-boundary constructors / exporters
+# ---------------------------------------------------------------------------
+
+def _next_capacity(n: int, capacity: Optional[int]) -> int:
+    if capacity is not None:
+        if capacity < n:
+            raise ValueError(f"capacity {capacity} < row count {n}")
+        return capacity
+    return max(8, n)
+
+
+def from_numpy(values: np.ndarray, *, validity: Optional[np.ndarray] = None,
+               capacity: Optional[int] = None,
+               string_width: int = DEFAULT_STRING_WIDTH,
+               dtype: Optional[DataType] = None) -> Column:
+    """Build a Column from a host numpy array (object/str arrays become
+    padded byte matrices)."""
+    values = np.asarray(values)
+    n = len(values)
+    cap = _next_capacity(n, capacity)
+    if values.dtype.kind in ("U", "S", "O"):
+        enc = [v if isinstance(v, bytes) else str(v).encode("utf-8")
+               for v in values]
+        width = max([string_width] + [len(b) for b in enc]) if enc else string_width
+        mat = np.zeros((cap, width), np.uint8)
+        lens = np.zeros((cap,), np.int32)
+        for i, b in enumerate(enc):
+            mat[i, : len(b)] = np.frombuffer(b, np.uint8)
+            lens[i] = len(b)
+        valid = np.zeros((cap,), bool)
+        valid[:n] = True if validity is None else validity[:n]
+        dt = dtype or dtypes.string
+        return Column(jnp.asarray(mat), jnp.asarray(valid), jnp.asarray(lens), dt)
+    if values.dtype.kind == "M":
+        # datetime64 -> int64 microseconds (Arrow timestamp physical layout)
+        values = values.astype("datetime64[us]").astype(np.int64)
+        dt = dtype or dtypes.timestamp("us")
+    else:
+        dt = dtype or dtypes.from_numpy_dtype(values.dtype)
+    buf = np.zeros((cap,), values.dtype)
+    buf[:n] = values
+    valid = np.zeros((cap,), bool)
+    valid[:n] = True if validity is None else validity[:n]
+    return Column(jnp.asarray(buf), jnp.asarray(valid), None, dt)
+
+
+def from_arrow(arr, *, capacity: Optional[int] = None,
+               string_width: int = DEFAULT_STRING_WIDTH) -> Column:
+    """Build a Column from a pyarrow Array/ChunkedArray (the ingest bridge the
+    reference does via arrow memory directly, cpp/src/cylon/table.cpp
+    FromArrowTable)."""
+    import pyarrow as pa
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    dt = dtypes.from_arrow_type(arr.type)
+    n = len(arr)
+    validity = np.ones((n,), bool)
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+    if dtypes.is_string_like(dt):
+        py = arr.to_pylist()
+        enc = [b"" if v is None else (v if isinstance(v, bytes) else v.encode("utf-8"))
+               for v in py]
+        obj = np.empty((n,), object)
+        obj[:] = enc
+        return from_numpy(obj, validity=validity, capacity=capacity,
+                          string_width=string_width, dtype=dt)
+    if arr.null_count:
+        # fill nulls BEFORE to_numpy: a nullable int64 otherwise detours
+        # through float64 + NaN, silently rounding values above 2^53
+        if pa.types.is_boolean(arr.type):
+            arr = arr.fill_null(False)
+        elif pa.types.is_integer(arr.type) or pa.types.is_floating(arr.type):
+            arr = arr.fill_null(0)
+    np_vals = arr.to_numpy(zero_copy_only=False)
+    if np_vals.dtype.kind in ("O", "m", "M") or np_vals.dtype == object:
+        np_vals = np.asarray(arr.cast(dtypes.to_arrow_type(dt)).to_numpy(zero_copy_only=False))
+        if np_vals.dtype == object:
+            np_vals = np.array([0 if v is None else v for v in np_vals],
+                               dtype=dt.numpy_dtype())
+    np_vals = np.ascontiguousarray(np_vals)
+    if np_vals.dtype.kind == "f" and arr.null_count:
+        np_vals = np.nan_to_num(np_vals, copy=False)
+    if np_vals.dtype != dt.numpy_dtype():
+        np_vals = np_vals.astype(dt.numpy_dtype())
+    return from_numpy(np_vals, validity=validity, capacity=capacity, dtype=dt)
+
+
+def to_numpy(col: Column, row_count: int):
+    """Export valid rows to host. Strings come back as an object array of
+    ``bytes`` decoded to str when valid utf-8."""
+    n = int(row_count)
+    valid = np.asarray(col.validity[:n])
+    if col.is_string:
+        mat = np.asarray(col.data[:n])
+        lens = np.asarray(col.lengths[:n])
+        out = np.empty((n,), object)
+        for i in range(n):
+            if not valid[i]:
+                out[i] = None
+                continue
+            b = mat[i, : lens[i]].tobytes()
+            try:
+                out[i] = b.decode("utf-8")
+            except UnicodeDecodeError:
+                out[i] = b
+        return out
+    vals = np.asarray(col.data[:n])
+    if valid.all():
+        return vals
+    out = vals.astype(object)
+    out[~valid] = None
+    return out
+
+
+def to_arrow(col: Column, row_count: int):
+    """Export valid rows to a pyarrow Array (host boundary, re-ragging the
+    padded byte matrices back into offsets+bytes)."""
+    import pyarrow as pa
+
+    n = int(row_count)
+    valid = np.asarray(col.validity[:n])
+    mask = None if valid.all() else ~valid
+    at = dtypes.to_arrow_type(col.dtype)
+    if col.is_string:
+        mat = np.asarray(col.data[:n])
+        lens = np.asarray(col.lengths[:n])
+        vals = [mat[i, : lens[i]].tobytes() for i in range(n)]
+        if col.dtype.type == Type.STRING:
+            vals = [v.decode("utf-8", errors="replace") for v in vals]
+        return pa.array(vals, type=at, mask=mask)
+    vals = np.asarray(col.data[:n])
+    return pa.array(vals, type=at, mask=mask)

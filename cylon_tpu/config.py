@@ -1,0 +1,100 @@
+"""Builder-style option objects.
+
+The reference has no global flag system; options travel as small builder
+objects (SURVEY §5): ``JoinConfig`` (cpp/src/cylon/join/join_config.hpp:22-89),
+``SortOptions`` (table.hpp:365-373), CSV/Parquet options (under io/).  Same
+here; the IO options live in cylon_tpu.io.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple, Union
+
+
+class JoinType(enum.IntEnum):
+    """reference: join/join_config.hpp JoinType."""
+
+    INNER = 0
+    LEFT = 1
+    RIGHT = 2
+    FULL_OUTER = 3
+
+
+class JoinAlgorithm(enum.IntEnum):
+    """reference: join/join_config.hpp JoinAlgorithm {SORT, HASH}.
+
+    On TPU both map to the fused sort-merge kernel today (sort is the
+    hardware-native strategy; a Pallas hash-table probe is the planned HASH
+    specialization), so the enum is honored for API parity and algorithm
+    selection is a hint.
+    """
+
+    SORT = 0
+    HASH = 1
+
+
+_JOIN_TYPE_OF = {
+    "inner": JoinType.INNER, "left": JoinType.LEFT, "right": JoinType.RIGHT,
+    "fullouter": JoinType.FULL_OUTER, "full_outer": JoinType.FULL_OUTER,
+    "outer": JoinType.FULL_OUTER,
+}
+_ALGO_OF = {"sort": JoinAlgorithm.SORT, "hash": JoinAlgorithm.HASH}
+
+
+def _as_tuple(v) -> Tuple[int, ...]:
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,)
+
+
+@dataclass(frozen=True)
+class JoinConfig:
+    """reference: join/join_config.hpp:29-89 (type × algorithm × key columns
+    × output-name prefixes)."""
+
+    join_type: JoinType = JoinType.INNER
+    algorithm: JoinAlgorithm = JoinAlgorithm.SORT
+    left_on: Tuple = ()
+    right_on: Tuple = ()
+    left_prefix: str = "l_"
+    right_prefix: str = "r_"
+
+    @staticmethod
+    def of(join_type: Union[str, JoinType], algorithm: Union[str, JoinAlgorithm] = "sort",
+           left_on=(), right_on=(), left_prefix: str = "l_", right_prefix: str = "r_") -> "JoinConfig":
+        if isinstance(join_type, str):
+            join_type = _JOIN_TYPE_OF[join_type.lower().replace("-", "_")]
+        if isinstance(algorithm, str):
+            algorithm = _ALGO_OF[algorithm.lower()]
+        return JoinConfig(join_type, algorithm, _as_tuple(left_on), _as_tuple(right_on),
+                          left_prefix, right_prefix)
+
+    # reference-parity factories (join_config.hpp InnerJoin/LeftJoin/...)
+    @staticmethod
+    def InnerJoin(left_on, right_on, algorithm="sort") -> "JoinConfig":
+        return JoinConfig.of("inner", algorithm, left_on, right_on)
+
+    @staticmethod
+    def LeftJoin(left_on, right_on, algorithm="sort") -> "JoinConfig":
+        return JoinConfig.of("left", algorithm, left_on, right_on)
+
+    @staticmethod
+    def RightJoin(left_on, right_on, algorithm="sort") -> "JoinConfig":
+        return JoinConfig.of("right", algorithm, left_on, right_on)
+
+    @staticmethod
+    def FullOuterJoin(left_on, right_on, algorithm="sort") -> "JoinConfig":
+        return JoinConfig.of("full_outer", algorithm, left_on, right_on)
+
+
+@dataclass(frozen=True)
+class SortOptions:
+    """reference: table.hpp:365-373 SortOptions{ascending, num_bins,
+    num_samples} — bins/samples drive the sampled-histogram range
+    partitioner of DistributedSort."""
+
+    ascending: bool = True
+    num_bins: int = 0        # 0 -> 16 * world_size (reference default)
+    num_samples: int = 0     # 0 -> min(row_count, 4096) per shard
+    nulls_first: bool = True

@@ -1,0 +1,182 @@
+"""Execution context.
+
+TPU-native analog of the reference's ``CylonContext`` (reference:
+cpp/src/cylon/ctx/cylon_context.hpp:29-146, cylon_context.cpp:25-116) and its
+communicator configs (cpp/src/cylon/net/comm_config.hpp, comm_type.hpp:20-22).
+
+Where the reference initializes MPI and hands out per-operation "edge"
+sequence numbers so concurrent all-to-alls don't collide, the TPU context
+owns a ``jax.sharding.Mesh`` over the device axis ``'p'`` — the analog of
+``MPI_COMM_WORLD`` — and nothing else: XLA orders collectives by program
+order, so edge tags are unnecessary (kept only for API parity).
+
+``world_size`` == number of devices on the mesh; a "rank" is a mesh position.
+Multi-host pods extend the same mesh across processes via
+``jax.distributed.initialize`` (collectives then ride ICI within a slice and
+DCN across slices — the role MPI point-to-point plays in the reference).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+PARTITION_AXIS = "p"
+
+
+class CommType(enum.IntEnum):
+    """Communication backends (reference: net/comm_type.hpp:20-22 enumerates
+    LOCAL/MPI/TCP/UCX with only MPI implemented; here the distributed backend
+    is XLA collectives over ICI/DCN)."""
+
+    LOCAL = 0
+    TPU = 1       # XLA collectives over ICI/DCN (the MPI replacement)
+    CPU_SIM = 2   # host-simulated multi-device mesh (tests)
+
+
+class CommConfig:
+    """Base communicator config (reference: net/comm_config.hpp)."""
+
+    def comm_type(self) -> CommType:
+        raise NotImplementedError
+
+
+class LocalConfig(CommConfig):
+    def comm_type(self) -> CommType:
+        return CommType.LOCAL
+
+
+class TPUConfig(CommConfig):
+    """Distributed config over a device mesh (reference analog: MPIConfig,
+    net/mpi/mpi_communicator.cpp:27-49).
+
+    devices: explicit device list; default = all of ``jax.devices()``.
+    """
+
+    def __init__(self, devices=None, world_size: Optional[int] = None):
+        self.devices = devices
+        self.world_size = world_size
+
+    def comm_type(self) -> CommType:
+        return CommType.TPU
+
+
+class CylonContext:
+    """Entry point holding the mesh, config map and sequence counter.
+
+    Mirrors the reference surface: ``Init/InitDistributed/GetRank/
+    GetWorldSize/GetNeighbours/AddConfig/GetConfig/GetNextSequence/Barrier/
+    Finalize`` (ctx/cylon_context.hpp:29-146), re-based on a JAX mesh.
+    """
+
+    def __init__(self, config: Optional[CommConfig] = None, distributed: bool = False):
+        import jax
+
+        self._config: Dict[str, str] = {}
+        self._sequence = 0
+        self._lock = threading.Lock()
+        self._finalized = False
+        self.distributed = distributed or (
+            config is not None and config.comm_type() != CommType.LOCAL)
+        if not self.distributed:
+            self.devices = np.array(jax.devices()[:1])
+        else:
+            cfg = config if isinstance(config, TPUConfig) else TPUConfig()
+            devs = list(cfg.devices) if cfg.devices is not None else list(jax.devices())
+            if cfg.world_size is not None:
+                devs = devs[: cfg.world_size]
+            self.devices = np.array(devs)
+        from jax.sharding import Mesh
+
+        self.mesh = Mesh(self.devices, (PARTITION_AXIS,))
+
+    # -- reference-parity static factories (ctx/cylon_context.cpp:25-43) ----
+    @staticmethod
+    def Init() -> "CylonContext":
+        return CylonContext(LocalConfig(), distributed=False)
+
+    @staticmethod
+    def InitDistributed(config: CommConfig) -> "CylonContext":
+        if config.comm_type() == CommType.LOCAL:
+            raise ValueError("Local communication config passed to InitDistributed")
+        return CylonContext(config, distributed=True)
+
+    # -- identity ----------------------------------------------------------
+    def GetRank(self) -> int:
+        # process-level rank (multi-host); mesh positions are the data ranks
+        import jax
+
+        return jax.process_index() if self.distributed else 0
+
+    def GetWorldSize(self) -> int:
+        return int(self.devices.size) if self.distributed else 1
+
+    @property
+    def world_size(self) -> int:
+        return self.GetWorldSize()
+
+    def GetNeighbours(self, include_self: bool = False) -> List[int]:
+        return [i for i in range(self.GetWorldSize())
+                if include_self or i != self.GetRank()]
+
+    def is_distributed(self) -> bool:
+        return self.distributed
+
+    # -- config k/v map (cylon_context.cpp:60-69) --------------------------
+    def AddConfig(self, key: str, value: str) -> None:
+        self._config[key] = value
+
+    def GetConfig(self, key: str, default: str = "") -> str:
+        return self._config.get(key, default)
+
+    # -- sequence / barrier / finalize -------------------------------------
+    def GetNextSequence(self) -> int:
+        # XLA orders collectives by program order; kept for API parity only
+        with self._lock:
+            self._sequence += 1
+            return self._sequence
+
+    def Barrier(self) -> None:
+        """Block the host until all devices reach this point — a 1-element
+        psum over the mesh, the collective analog of MPI_Barrier.  The jitted
+        program and its input are cached on the context so repeat barriers
+        cost microseconds, not a recompile."""
+        if not self.distributed:
+            return
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cached = getattr(self, "_barrier_fn", None)
+        if cached is None:
+            mesh = self.mesh
+            fn = jax.jit(jax.shard_map(
+                lambda v: jax.lax.psum(v, PARTITION_AXIS),
+                mesh=mesh, in_specs=P(PARTITION_AXIS), out_specs=P()))
+            x = jax.device_put(
+                jnp.zeros((self.GetWorldSize(),), jnp.int32),
+                NamedSharding(mesh, P(PARTITION_AXIS)))
+            cached = (fn, x)
+            self._barrier_fn = cached
+        fn, x = cached
+        fn(x).block_until_ready()
+
+    def Finalize(self) -> None:
+        self._finalized = True
+
+    def __repr__(self) -> str:
+        kind = "distributed" if self.distributed else "local"
+        return f"CylonContext({kind}, world_size={self.GetWorldSize()})"
+
+
+_default_local: Optional[CylonContext] = None
+
+
+def default_context() -> CylonContext:
+    global _default_local
+    if _default_local is None:
+        _default_local = CylonContext.Init()
+    return _default_local

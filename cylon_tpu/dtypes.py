@@ -1,0 +1,302 @@
+"""Data type system.
+
+TPU-native analog of the reference's stripped-down Arrow type system
+(reference: cpp/src/cylon/data_types.hpp:25-120 and
+cpp/src/cylon/arrow/arrow_types.{hpp,cpp}).  The reference wraps an enum
+``Type::type`` plus conversion to/from arrow types and a schema validity
+check; we do the same, mapping to JAX/numpy dtypes as the device
+representation:
+
+- fixed-width numerics / bools / temporal types -> the matching jnp dtype
+  (temporal values travel as int64 on device, like Arrow's physical layout)
+- STRING / BINARY -> fixed-width padded ``uint8[capacity, width]`` byte
+  matrices plus an int32 length vector (TPU kernels need static shapes; this
+  replaces Arrow's offsets+bytes representation on device, and round-trips
+  through offsets+bytes at the host boundary).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "Type", "Layout", "DataType",
+    "bool_", "uint8", "int8", "uint16", "int16", "uint32", "int32",
+    "uint64", "int64", "half_float", "float_", "double",
+    "string", "binary", "fixed_size_binary", "date32", "date64",
+    "timestamp", "time32", "time64",
+    "from_numpy_dtype", "to_numpy_dtype", "from_arrow_type", "to_arrow_type",
+    "is_numeric", "is_string_like", "is_floating", "is_integer",
+]
+
+
+class Type(enum.IntEnum):
+    """Logical types (reference: cpp/src/cylon/data_types.hpp:25-86)."""
+
+    BOOL = 0
+    UINT8 = 1
+    INT8 = 2
+    UINT16 = 3
+    INT16 = 4
+    UINT32 = 5
+    INT32 = 6
+    UINT64 = 7
+    INT64 = 8
+    HALF_FLOAT = 9
+    FLOAT = 10
+    DOUBLE = 11
+    STRING = 12
+    BINARY = 13
+    FIXED_SIZE_BINARY = 14
+    DATE32 = 15
+    DATE64 = 16
+    TIMESTAMP = 17
+    TIME32 = 18
+    TIME64 = 19
+    DECIMAL = 20
+    DURATION = 21
+    INTERVAL = 22
+    LIST = 23
+    FIXED_SIZE_LIST = 24
+    EXTENSION = 25
+    MAX_ID = 26
+
+
+class Layout(enum.IntEnum):
+    """Physical layout (reference: data_types.hpp Layout)."""
+
+    FIXED_WIDTH = 1
+    VARIABLE_WIDTH = 2
+
+
+_NUMPY_OF = {
+    Type.BOOL: np.bool_,
+    Type.UINT8: np.uint8,
+    Type.INT8: np.int8,
+    Type.UINT16: np.uint16,
+    Type.INT16: np.int16,
+    Type.UINT32: np.uint32,
+    Type.INT32: np.int32,
+    Type.UINT64: np.uint64,
+    Type.INT64: np.int64,
+    Type.HALF_FLOAT: np.float16,
+    Type.FLOAT: np.float32,
+    Type.DOUBLE: np.float64,
+    # device representation of byte-strings is uint8 matrices
+    Type.STRING: np.uint8,
+    Type.BINARY: np.uint8,
+    Type.FIXED_SIZE_BINARY: np.uint8,
+    # temporal types travel as their Arrow physical integer widths
+    Type.DATE32: np.int32,
+    Type.DATE64: np.int64,
+    Type.TIMESTAMP: np.int64,
+    Type.TIME32: np.int32,
+    Type.TIME64: np.int64,
+    Type.DURATION: np.int64,
+}
+
+_TYPE_OF_NUMPY = {
+    np.dtype(np.bool_): Type.BOOL,
+    np.dtype(np.uint8): Type.UINT8,
+    np.dtype(np.int8): Type.INT8,
+    np.dtype(np.uint16): Type.UINT16,
+    np.dtype(np.int16): Type.INT16,
+    np.dtype(np.uint32): Type.UINT32,
+    np.dtype(np.int32): Type.INT32,
+    np.dtype(np.uint64): Type.UINT64,
+    np.dtype(np.int64): Type.INT64,
+    np.dtype(np.float16): Type.HALF_FLOAT,
+    np.dtype(np.float32): Type.FLOAT,
+    np.dtype(np.float64): Type.DOUBLE,
+}
+
+
+@dataclass(frozen=True)
+class DataType:
+    """A logical column type (reference: data_types.hpp DataType).
+
+    ``byte_width`` is only meaningful for FIXED_SIZE_BINARY; ``unit`` for
+    temporal types (one of 's','ms','us','ns').
+    """
+
+    type: Type
+    byte_width: int = -1
+    unit: Optional[str] = None
+
+    @property
+    def layout(self) -> Layout:
+        if self.type in (Type.STRING, Type.BINARY):
+            return Layout.VARIABLE_WIDTH
+        return Layout.FIXED_WIDTH
+
+    def numpy_dtype(self) -> np.dtype:
+        try:
+            return np.dtype(_NUMPY_OF[self.type])
+        except KeyError:
+            raise TypeError(f"type {self.type.name} has no device representation")
+
+    def __repr__(self) -> str:
+        if self.type == Type.FIXED_SIZE_BINARY:
+            return f"fixed_size_binary[{self.byte_width}]"
+        if self.unit:
+            return f"{self.type.name.lower()}[{self.unit}]"
+        return self.type.name.lower()
+
+
+def _mk(t: Type) -> DataType:
+    return DataType(t)
+
+
+bool_ = _mk(Type.BOOL)
+uint8 = _mk(Type.UINT8)
+int8 = _mk(Type.INT8)
+uint16 = _mk(Type.UINT16)
+int16 = _mk(Type.INT16)
+uint32 = _mk(Type.UINT32)
+int32 = _mk(Type.INT32)
+uint64 = _mk(Type.UINT64)
+int64 = _mk(Type.INT64)
+half_float = _mk(Type.HALF_FLOAT)
+float_ = _mk(Type.FLOAT)
+double = _mk(Type.DOUBLE)
+string = _mk(Type.STRING)
+binary = _mk(Type.BINARY)
+date32 = _mk(Type.DATE32)
+date64 = _mk(Type.DATE64)
+
+
+def fixed_size_binary(width: int) -> DataType:
+    return DataType(Type.FIXED_SIZE_BINARY, byte_width=width)
+
+
+def timestamp(unit: str = "us") -> DataType:
+    return DataType(Type.TIMESTAMP, unit=unit)
+
+
+def time32(unit: str = "ms") -> DataType:
+    return DataType(Type.TIME32, unit=unit)
+
+
+def time64(unit: str = "us") -> DataType:
+    return DataType(Type.TIME64, unit=unit)
+
+
+def is_numeric(dt: DataType) -> bool:
+    return Type.BOOL <= dt.type <= Type.DOUBLE
+
+
+def is_string_like(dt: DataType) -> bool:
+    return dt.type in (Type.STRING, Type.BINARY, Type.FIXED_SIZE_BINARY)
+
+
+def is_floating(dt: DataType) -> bool:
+    return dt.type in (Type.HALF_FLOAT, Type.FLOAT, Type.DOUBLE)
+
+
+def is_integer(dt: DataType) -> bool:
+    return Type.UINT8 <= dt.type <= Type.INT64
+
+
+def from_numpy_dtype(dtype) -> DataType:
+    dtype = np.dtype(dtype)
+    if dtype.kind in ("U", "S", "O"):
+        return string
+    if dtype.kind == "M":
+        return timestamp("us")
+    try:
+        return DataType(_TYPE_OF_NUMPY[dtype])
+    except KeyError:
+        raise TypeError(f"unsupported numpy dtype {dtype}")
+
+
+def to_numpy_dtype(dt: DataType) -> np.dtype:
+    return dt.numpy_dtype()
+
+
+# ---------------------------------------------------------------------------
+# Arrow interop (reference: cpp/src/cylon/arrow/arrow_types.cpp ToCylonType /
+# convertToArrowType).  pyarrow is imported lazily so the device-side library
+# has no hard host-IO dependency.
+# ---------------------------------------------------------------------------
+
+def from_arrow_type(at) -> DataType:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return bool_
+    if pa.types.is_uint8(at):
+        return uint8
+    if pa.types.is_int8(at):
+        return int8
+    if pa.types.is_uint16(at):
+        return uint16
+    if pa.types.is_int16(at):
+        return int16
+    if pa.types.is_uint32(at):
+        return uint32
+    if pa.types.is_int32(at):
+        return int32
+    if pa.types.is_uint64(at):
+        return uint64
+    if pa.types.is_int64(at):
+        return int64
+    if pa.types.is_float16(at):
+        return half_float
+    if pa.types.is_float32(at):
+        return float_
+    if pa.types.is_float64(at):
+        return double
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return string
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return binary
+    if pa.types.is_fixed_size_binary(at):
+        return fixed_size_binary(at.byte_width)
+    if pa.types.is_date32(at):
+        return date32
+    if pa.types.is_date64(at):
+        return date64
+    if pa.types.is_timestamp(at):
+        return timestamp(at.unit)
+    if pa.types.is_time32(at):
+        return time32(at.unit)
+    if pa.types.is_time64(at):
+        return time64(at.unit)
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def to_arrow_type(dt: DataType):
+    import pyarrow as pa
+
+    m = {
+        Type.BOOL: pa.bool_(),
+        Type.UINT8: pa.uint8(),
+        Type.INT8: pa.int8(),
+        Type.UINT16: pa.uint16(),
+        Type.INT16: pa.int16(),
+        Type.UINT32: pa.uint32(),
+        Type.INT32: pa.int32(),
+        Type.UINT64: pa.uint64(),
+        Type.INT64: pa.int64(),
+        Type.HALF_FLOAT: pa.float16(),
+        Type.FLOAT: pa.float32(),
+        Type.DOUBLE: pa.float64(),
+        Type.STRING: pa.string(),
+        Type.BINARY: pa.binary(),
+        Type.DATE32: pa.date32(),
+        Type.DATE64: pa.date64(),
+    }
+    if dt.type in m:
+        return m[dt.type]
+    if dt.type == Type.FIXED_SIZE_BINARY:
+        return pa.binary(dt.byte_width)
+    if dt.type == Type.TIMESTAMP:
+        return pa.timestamp(dt.unit or "us")
+    if dt.type == Type.TIME32:
+        return pa.time32(dt.unit or "ms")
+    if dt.type == Type.TIME64:
+        return pa.time64(dt.unit or "us")
+    raise TypeError(f"unsupported type {dt}")

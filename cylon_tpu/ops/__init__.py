@@ -1,0 +1,7 @@
+"""Local relational kernels (jit/XLA programs).
+
+TPU-native replacement for the reference's C++ kernel layer L2
+(cpp/src/cylon/join, groupby, compute, arrow/ kernels): sort-based joins,
+segment-reduce group-bys, set ops, unique, aggregates — all static-shape XLA
+programs over padded column buffers.
+"""

@@ -1,0 +1,63 @@
+"""Scalar column aggregates.
+
+TPU-native replacement for the reference's compute layer
+(cpp/src/cylon/compute/aggregates.cpp:30-156 — local arrow::compute reduction
+then an MPI_Allreduce over the scalar, compute/aggregate_utils.hpp:124-144).
+The local reduction is a masked jnp reduce; the distributed combine happens
+in cylon_tpu.parallel via psum/pmin/pmax (see parallel/collectives.py) —
+the direct analog of mpi::AllReduce (net/mpi/mpi_operations.cpp:18-78).
+"""
+from __future__ import annotations
+
+import enum
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from . import compact
+
+
+class ReduceOp(enum.IntEnum):
+    """reference: net/comm_operations.hpp:26-30."""
+
+    SUM = 0
+    MIN = 1
+    MAX = 2
+    PROD = 3
+    COUNT = 4
+
+
+@partial(jax.jit, static_argnames=("op",))
+def scalar_agg(col: Column, count, op: ReduceOp):
+    """(value, valid_count) for one column's live, non-null rows."""
+    cap = col.data.shape[0]
+    if col.is_string and op not in (ReduceOp.COUNT,):
+        raise TypeError("scalar aggregation unsupported on string columns")
+    mask = col.validity & compact.live_mask(cap, count)
+    n = jnp.sum(mask, dtype=jnp.int64)
+    if op == ReduceOp.COUNT:
+        return n, n
+    data = col.data
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int64)
+    if op == ReduceOp.SUM:
+        acc = data.astype(jnp.float64 if jnp.issubdtype(data.dtype, jnp.floating)
+                          else jnp.int64)
+        return jnp.sum(jnp.where(mask, acc, 0)), n
+    if op == ReduceOp.PROD:
+        acc = data.astype(jnp.float64 if jnp.issubdtype(data.dtype, jnp.floating)
+                          else jnp.int64)
+        return jnp.prod(jnp.where(mask, acc, 1)), n
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        lo, hi = -jnp.inf, jnp.inf
+    else:
+        info = jnp.iinfo(data.dtype)
+        lo, hi = info.min, info.max
+    if op == ReduceOp.MIN:
+        return jnp.min(jnp.where(mask, data, jnp.asarray(hi, data.dtype))), n
+    if op == ReduceOp.MAX:
+        return jnp.max(jnp.where(mask, data, jnp.asarray(lo, data.dtype))), n
+    raise ValueError(op)

@@ -1,0 +1,77 @@
+"""Shared helpers for multi-table kernels."""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from . import keys
+
+
+def widen_strings(a: Column, b: Column) -> Tuple[Column, Column]:
+    """Pad two string columns' byte matrices to a common width so they can be
+    concatenated / compared (zero padding preserves order)."""
+    if not a.is_string:
+        return a, b
+    wa, wb = a.string_width, b.string_width
+    w = max(wa, wb)
+
+    def pad(c: Column) -> Column:
+        if c.string_width == w:
+            return c
+        extra = jnp.zeros((c.data.shape[0], w - c.string_width), jnp.uint8)
+        return Column(jnp.concatenate([c.data, extra], axis=1), c.validity,
+                      c.lengths, c.dtype)
+
+    return pad(a), pad(b)
+
+
+def concat_columns(a: Column, b: Column) -> Column:
+    """Stack two columns' buffers (paddings and all) into one column of
+    capacity cap_a + cap_b."""
+    a, b = widen_strings(a, b)
+    data = jnp.concatenate([a.data, b.data], axis=0)
+    validity = jnp.concatenate([a.validity, b.validity])
+    lengths = None
+    if a.lengths is not None:
+        lengths = jnp.concatenate([a.lengths, b.lengths])
+    return Column(data, validity, lengths, a.dtype)
+
+
+def two_table_padding(cap_a: int, count_a, cap_b: int, count_b) -> jax.Array:
+    """Padding-flag operand for a concatenated pair of tables."""
+    idx = jnp.arange(cap_a + cap_b, dtype=jnp.int32)
+    in_a = idx < cap_a
+    pad_a = idx >= count_a
+    pad_b = (idx - cap_a) >= count_b
+    return jnp.where(in_a, pad_a, pad_b).astype(jnp.uint8)
+
+
+def combined_group_ids(cols_a: Sequence[Column], count_a,
+                       cols_b: Sequence[Column], count_b,
+                       key_a: Sequence[int], key_b: Sequence[int]):
+    """Lexsort the union of two tables' key rows and assign dense group ids.
+
+    This is the TPU replacement for the reference's hash-table row matching
+    (HashJoinKernel build/probe, arrow/arrow_hash_kernels.hpp:33-215, and the
+    RowComparator hash-sets of the set ops, table.cpp:522-734): after one
+    fused multi-key sort of all rows from both tables, rows with equal keys
+    share a dense int32 id, turning every equality problem downstream into
+    integer comparisons.
+
+    Returns (gid_a[cap_a], gid_b[cap_b], perm, sorted_ops, num_all_groups).
+    Padding rows from either table share the final (largest) group id.
+    """
+    cap_a = cols_a[0].data.shape[0]
+    cap_b = cols_b[0].data.shape[0]
+    n = cap_a + cap_b
+    operands: List[jax.Array] = [two_table_padding(cap_a, count_a, cap_b, count_b)]
+    for ia, ib in zip(key_a, key_b):
+        combined = concat_columns(cols_a[ia], cols_b[ib])
+        operands.extend(keys.column_operands(combined))
+    perm, sorted_ops = keys.lexsort_indices(operands, n)
+    gid_sorted, num_groups = keys.dense_group_ids(sorted_ops)
+    gid = jnp.zeros((n,), jnp.int32).at[perm].set(gid_sorted)
+    return gid[:cap_a], gid[cap_a:], perm, sorted_ops, num_groups

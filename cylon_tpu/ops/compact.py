@@ -1,0 +1,32 @@
+"""Mask -> front-packed compaction.
+
+The reference materializes filtered results via ``arrow::compute::Filter``
+over boolean masks (e.g. groupby index columns, hash_groupby.cpp:135-192;
+Select, table.cpp:491-520).  The static-shape XLA equivalent: a stable sort
+on the inverted mask yields a permutation that packs kept rows to the front
+in original order; the new dynamic row count is the mask popcount.  One fused
+sort+gather instead of a dynamically-sized filter.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def compact_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(perm, new_count): perm is a full-capacity permutation placing rows
+    where ``mask`` is True at the front, preserving order; new_count is the
+    number of kept rows (int32 scalar)."""
+    cap = mask.shape[0]
+    key = (~mask).astype(jnp.uint8)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    _, perm = jax.lax.sort((key, iota), num_keys=1, is_stable=True)
+    new_count = jnp.sum(mask, dtype=jnp.int32)
+    return perm, new_count
+
+
+def live_mask(capacity: int, row_count) -> jax.Array:
+    """bool[capacity]: True for rows below the dynamic row count."""
+    return jnp.arange(capacity, dtype=jnp.int32) < row_count

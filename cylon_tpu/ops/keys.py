@@ -1,0 +1,132 @@
+"""Row-key encoding for sort/equality kernels.
+
+The reference compares rows through virtual-dispatch comparator objects
+(cpp/src/cylon/arrow/arrow_comparator.hpp:25-189) and sorts via index
+quicksorts (arrow/arrow_kernels.hpp:180-314, util/sort.hpp).  On TPU the
+idiomatic equivalent is ``jax.lax.sort`` with **multiple key operands**
+(lexicographic, one fused XLA sort), so this module turns typed columns into
+flat sortable operands:
+
+- numeric column  -> [validity_key, data]  (nulls ordered first/last)
+- string column   -> [validity_key, w0, w1, ...] where wi are big-endian
+  uint64 words packed from the zero-padded byte matrix; zero padding keeps
+  bytewise lexicographic order identical to string order.
+- the row-padding flag is always the first operand so rows beyond the dynamic
+  row count sort to the back of every permutation.
+
+Row equality (multi-column, the job of TableRowComparator) becomes adjacent
+comparison of these operands after a lexsort, which then yields dense group
+ids via a prefix sum — the backbone of groupby/unique/set-ops/joins here.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+
+
+def pack_string_words(data: jax.Array) -> List[jax.Array]:
+    """Pack a uint8[n, L] byte matrix into ceil(L/8) uint64[n] big-endian
+    words; lexicographic order on the word tuple == bytewise order."""
+    n, width = data.shape
+    pad = (-width) % 8
+    if pad:
+        data = jnp.concatenate([data, jnp.zeros((n, pad), jnp.uint8)], axis=1)
+    nwords = data.shape[1] // 8
+    words = data.reshape(n, nwords, 8).astype(jnp.uint64)
+    shifts = jnp.array([56, 48, 40, 32, 24, 16, 8, 0], jnp.uint64)
+    packed = jnp.sum(words << shifts, axis=2, dtype=jnp.uint64)
+    return [packed[:, i] for i in range(nwords)]
+
+
+def column_operands(col: Column, *, nulls_first: bool = True,
+                    with_validity: bool = True) -> List[jax.Array]:
+    """Sortable operands for one column (most-significant first)."""
+    ops: List[jax.Array] = []
+    if with_validity:
+        if nulls_first:
+            ops.append(col.validity.astype(jnp.uint8))   # invalid(0) < valid(1)
+        else:
+            ops.append((~col.validity).astype(jnp.uint8))  # valid(0) < invalid(1)
+    if col.is_string:
+        ops.extend(pack_string_words(col.data))
+    else:
+        data = col.data
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.uint8)
+        ops.append(data)
+    return ops
+
+
+def padding_operand(capacity: int, row_count) -> jax.Array:
+    """First sort operand: 0 for live rows, 1 for padding, so padding always
+    lands at the back."""
+    return (jnp.arange(capacity, dtype=jnp.int32) >= row_count).astype(jnp.uint8)
+
+
+def build_operands(cols: Sequence[Column], row_count, capacity: int,
+                   *, ascending: Sequence[bool] | None = None,
+                   nulls_first: bool = True) -> List[jax.Array]:
+    """All sort operands for a multi-column key, padding flag first.
+
+    Descending order per column is realized by bit-flipping that column's
+    operands (works for the unsigned encodings; for signed/float data we
+    negate via the order-preserving unsigned reinterpretation).
+    """
+    ops: List[jax.Array] = [padding_operand(capacity, row_count)]
+    for i, col in enumerate(cols):
+        col_ops = column_operands(col, nulls_first=nulls_first)
+        if ascending is not None and not ascending[i]:
+            col_ops = [_invert_operand(o) for o in col_ops]
+        ops.extend(col_ops)
+    return ops
+
+
+def _invert_operand(x: jax.Array) -> jax.Array:
+    """Order-reversing transform for one operand."""
+    if jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+        return ~x
+    if jnp.issubdtype(x.dtype, jnp.signedinteger):
+        return -1 - x  # maps min->max order-reversed without overflow on wrap
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return -x
+    return ~x.astype(jnp.uint8)
+
+
+def lexsort_indices(operands: Sequence[jax.Array], capacity: int) -> Tuple[jax.Array, List[jax.Array]]:
+    """Stable lexicographic argsort. Returns (permutation, sorted_operands)."""
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(tuple(operands) + (iota,),
+                              num_keys=len(operands), is_stable=True)
+    perm = sorted_all[-1]
+    return perm, list(sorted_all[:-1])
+
+
+def rows_equal_adjacent(sorted_operands: Sequence[jax.Array]) -> jax.Array:
+    """bool[n]: row i has identical key to row i-1 (row 0 -> False).
+
+    Operand 0 is the padding flag, which participates: a padding row never
+    equals a live row, while padding rows equal each other (harmless — they
+    are masked out downstream)."""
+    eq = None
+    for op in sorted_operands:
+        e = jnp.concatenate([jnp.zeros((1,), bool), op[1:] == op[:-1]])
+        eq = e if eq is None else (eq & e)
+    return eq
+
+
+def dense_group_ids(sorted_operands: Sequence[jax.Array]) -> Tuple[jax.Array, jax.Array]:
+    """Dense group ids over sorted rows: (group_id[n], num_groups_incl_padding).
+
+    group_id is 0-based and nondecreasing along the sorted order; rows with
+    equal keys share an id.  ``num_groups`` counts all distinct keys present
+    including the single padding group when padding rows exist; callers mask
+    with the live-row count."""
+    eq = rows_equal_adjacent(sorted_operands)
+    new_group = ~eq
+    gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    num = gid[-1] + 1 if gid.shape[0] else jnp.zeros((), jnp.int32)
+    return gid, num

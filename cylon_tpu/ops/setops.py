@@ -1,0 +1,82 @@
+"""Local set operations: union / intersect / subtract (distinct semantics).
+
+TPU-native replacement for the reference's hash-set set ops
+(cpp/src/cylon/table.cpp:522-734 — ``std::unordered_set<pair<int8,int64>>``
+of ⟨table_id, row⟩ with composite RowComparator hash/eq over **all**
+columns).  Here: one fused lexsort of both tables' rows → dense group ids →
+per-group membership counts via segment sums → leader selection + compaction.
+Union keeps one representative of every distinct row; intersect keeps groups
+present in both tables; subtract keeps groups of A absent from B.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from . import common, compact
+
+
+@partial(jax.jit, static_argnames=("op", "out_capacity"))
+def set_op(cols_a: Tuple[Column, ...], count_a,
+           cols_b: Tuple[Column, ...], count_b,
+           op: str, out_capacity: int):
+    """op in {'union','intersect','subtract'}; schemas must match.
+
+    Returns (columns, row_count) with capacity ``out_capacity``.
+    """
+    cap_a = cols_a[0].data.shape[0]
+    cap_b = cols_b[0].data.shape[0]
+    n = cap_a + cap_b
+    ncols = len(cols_a)
+    key = tuple(range(ncols))
+    gid_a, gid_b, perm, sorted_ops, _ = common.combined_group_ids(
+        cols_a, count_a, cols_b, count_b, key, key)
+
+    live_sorted = jnp.take(
+        common.two_table_padding(cap_a, count_a, cap_b, count_b), perm) == 0
+    from_a_sorted = perm < cap_a
+    gid_sorted = jnp.where(from_a_sorted,
+                           jnp.take(gid_a, jnp.clip(perm, 0, cap_a - 1)),
+                           jnp.take(gid_b, jnp.clip(perm - cap_a, 0, cap_b - 1)))
+
+    cnt_a = jax.ops.segment_sum((live_sorted & from_a_sorted).astype(jnp.int32),
+                                gid_sorted, n)
+    cnt_b = jax.ops.segment_sum((live_sorted & ~from_a_sorted).astype(jnp.int32),
+                                gid_sorted, n)
+
+    leader = (~common_eq(sorted_ops)) & live_sorted
+    ga = jnp.take(cnt_a, gid_sorted) > 0
+    gb = jnp.take(cnt_b, gid_sorted) > 0
+    if op == "union":
+        keep = leader
+    elif op == "intersect":
+        keep = leader & ga & gb
+    elif op == "subtract":
+        keep = leader & ga & ~gb
+    else:
+        raise ValueError(op)
+
+    perm_keep, m = compact.compact_indices(keep)
+    combined = tuple(common.concat_columns(a, b) for a, b in zip(cols_a, cols_b))
+    out_live = jnp.arange(out_capacity, dtype=jnp.int32) < m
+    sel = jnp.take(perm, jnp.take(perm_keep, jnp.arange(out_capacity) % n))
+    out = tuple(c.take(sel, valid_mask=None) for c in combined)
+    # zero out rows beyond the result count for determinism
+    out = tuple(
+        Column(jnp.where(out_live if c.data.ndim == 1 else out_live[:, None],
+                         c.data, jnp.zeros((), c.data.dtype)),
+               c.validity & out_live,
+               None if c.lengths is None else jnp.where(out_live, c.lengths, 0),
+               c.dtype)
+        for c in out)
+    return out, m
+
+
+def common_eq(sorted_ops):
+    from . import keys
+
+    return keys.rows_equal_adjacent(sorted_ops)

@@ -1,0 +1,27 @@
+"""Local multi-column sort.
+
+Replaces the reference's index-sort kernels (cpp/src/cylon/arrow/
+arrow_kernels.hpp:180-314 NumericIndexSortKernel / SortIndicesInPlace,
+util/arrow_utils.cpp SortTable) with one fused ``jax.lax.sort`` over
+lexicographic key operands + a gather.  Padding rows always sort last, so
+the dynamic row count is unchanged.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from ..column import Column
+from . import keys
+
+
+def sort_rows(cols: Tuple[Column, ...], count, by: Sequence[int],
+              ascending: Sequence[bool] | None = None,
+              nulls_first: bool = True) -> Tuple[Tuple[Column, ...], object]:
+    """Sort all columns by the key columns ``by``; returns (columns, count)."""
+    cap = cols[0].data.shape[0]
+    if ascending is None:
+        ascending = [True] * len(by)
+    operands = keys.build_operands([cols[i] for i in by], count, cap,
+                                   ascending=ascending, nulls_first=nulls_first)
+    perm, _ = keys.lexsort_indices(operands, cap)
+    return tuple(c.take(perm) for c in cols), count

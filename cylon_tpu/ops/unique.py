@@ -1,0 +1,50 @@
+"""Local unique / drop-duplicates.
+
+TPU-native replacement for the reference's hash-set unique
+(cpp/src/cylon/table.cpp:966-1029 — bytell hash-set insert per row building
+a keep-filter, with 'first'/'last' keep semantics).  Here: lexsort the key
+columns, dense group ids, pick each group's first (or last) occurrence *in
+original row order* via a segment min/max over original indices, then
+compact — output preserves the input's row order like the reference's
+filter does.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from . import compact, keys
+
+
+@partial(jax.jit, static_argnames=("key_idx", "keep"))
+def unique(cols: Tuple[Column, ...], count, key_idx: Tuple[int, ...],
+           keep: str = "first"):
+    """Returns (columns, new_count): rows with a duplicate key removed,
+    keeping the first or last occurrence, original order preserved."""
+    cap = cols[0].data.shape[0]
+    key_cols = [cols[i] for i in key_idx]
+    operands = keys.build_operands(key_cols, count, cap)
+    perm, sorted_ops = keys.lexsort_indices(operands, cap)
+    gid, _ = keys.dense_group_ids(sorted_ops)
+    live_sorted = jnp.arange(cap, dtype=jnp.int32) < count
+
+    orig = perm  # original row index of each sorted position
+    if keep == "first":
+        rep = jax.ops.segment_min(jnp.where(live_sorted, orig, cap), gid, cap)
+    elif keep == "last":
+        rep = jax.ops.segment_max(jnp.where(live_sorted, orig, -1), gid, cap)
+    else:
+        raise ValueError(f"keep must be 'first' or 'last', got {keep!r}")
+
+    valid_rep = (rep >= 0) & (rep < cap)
+    keep_mask = jnp.zeros((cap,), jnp.bool_).at[jnp.clip(rep, 0, cap - 1)].max(
+        valid_rep)
+    keep_mask = keep_mask & compact.live_mask(cap, count)
+
+    perm_keep, m = compact.compact_indices(keep_mask)
+    out = tuple(c.take(perm_keep, valid_mask=compact.live_mask(cap, m)) for c in cols)
+    return out, m

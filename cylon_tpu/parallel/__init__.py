@@ -1,0 +1,12 @@
+"""Distributed layer: mesh collectives, partitioning, shuffle.
+
+TPU-native replacement for the reference's L1 communication stack
+(cpp/src/cylon/net: Channel/Buffer/TxRequest/AllToAll state machines over
+MPI_Isend/Irecv) and L3 partitioning (cpp/src/cylon/partition,
+arrow/arrow_partition_kernels.hpp).  The entire nonblocking P2P machinery —
+header-first protocol, per-peer state machines, fin handshakes, busy-wait
+progress loops (net/mpi/mpi_channel.cpp:30-247, net/ops/all_to_all.cpp:
+26-178) — collapses into XLA collectives on a 1-D device mesh: program
+order replaces edge tags, a psum'd count matrix replaces length headers,
+and ``lax.all_to_all`` over ICI/DCN replaces the channel fabric.
+"""

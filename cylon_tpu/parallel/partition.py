@@ -1,0 +1,96 @@
+"""Row -> target-shard assignment (the "sharding strategies").
+
+TPU-native replacement for the reference's partition layer
+(cpp/src/cylon/partition/partition.cpp, arrow/arrow_partition_kernels.hpp):
+
+- ``hash_targets``: multi-column murmur-style row hash, modulo (or mask for
+  power-of-two world sizes, arrow_partition_kernels.hpp:60-70) — the analog
+  of PartitionByHashing + ModuloPartitionKernel/NumericHashPartitionKernel.
+- ``range_targets``: the sampled-histogram range partitioner behind
+  DistributedSort (arrow_partition_kernels.hpp:394-519 RangePartitionKernel):
+  sample rows, AllReduce global min/max, build a global histogram with one
+  psum (the mirror of the MPI_Allreduce at :469-480), prefix-sum it into
+  monotone bin->partition cut points.
+
+Both run *inside* shard_map: each shard computes targets for its own rows.
+Padding rows get target ``world`` (a sentinel bucket nothing is sent to).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from ..ops import compact as compact_mod
+from ..ops import hashing
+from . import collectives
+
+
+def hash_targets(cols: Sequence[Column], count, key_idx: Sequence[int],
+                 world: int) -> jax.Array:
+    """int32[cap] target shard per row (``world`` for padding rows)."""
+    cap = cols[0].data.shape[0]
+    h = hashing.hash_columns([cols[i] for i in key_idx])
+    if world & (world - 1) == 0:
+        t = (h & jnp.uint32(world - 1)).astype(jnp.int32)
+    else:
+        t = (h % jnp.uint32(world)).astype(jnp.int32)
+    live = compact_mod.live_mask(cap, count)
+    return jnp.where(live, t, jnp.int32(world))
+
+
+def range_targets(col: Column, count, world: int, *, num_bins: int,
+                  num_samples: int, ascending: bool = True,
+                  nulls_first: bool = True) -> jax.Array:
+    """Range-partition targets for one numeric sort column, globally
+    monotone: rows in shard t all order before rows in shard t+1.
+
+    Collective footprint (identical in shape to the reference): pmin/pmax of
+    the column extrema + one psum of the (num_bins,) sample histogram.
+    """
+    cap = col.data.shape[0]
+    live = compact_mod.live_mask(cap, count) & col.validity
+    data = col.data
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int32)
+    fdata = data.astype(jnp.float64)
+
+    big = jnp.asarray(jnp.finfo(jnp.float64).max, jnp.float64)
+    gmin = collectives.allreduce_min(jnp.min(jnp.where(live, fdata, big)))
+    gmax = collectives.allreduce_max(jnp.max(jnp.where(live, fdata, -big)))
+    span = jnp.maximum(gmax - gmin, 1e-300)
+
+    # deterministic stride sample of live rows (reference samples `num_samples`
+    # values per worker, partition.cpp:181)
+    n_live = jnp.sum(live, dtype=jnp.int32)
+    pos = (jnp.arange(num_samples, dtype=jnp.float64)
+           * jnp.maximum(n_live, 1).astype(jnp.float64) / num_samples)
+    pos = jnp.clip(pos.astype(jnp.int32), 0, cap - 1)
+    # live rows are not contiguous post-filter; sample from a compacted view
+    perm, m = compact_mod.compact_indices(live)
+    sample_idx = jnp.take(perm, jnp.clip(pos, 0, cap - 1))
+    sample = jnp.take(fdata, sample_idx)
+    sample_ok = pos < m
+
+    sbin = jnp.clip(((sample - gmin) / span * num_bins).astype(jnp.int32),
+                    0, num_bins - 1)
+    hist = jax.ops.segment_sum(sample_ok.astype(jnp.int64), sbin, num_bins)
+    hist = collectives.allreduce_sum(hist)          # global histogram (psum)
+    total = jnp.maximum(jnp.sum(hist), 1)
+
+    # monotone bin -> partition map from the histogram mass midpoint
+    cum = jnp.cumsum(hist)
+    mid = (cum - hist / 2).astype(jnp.float64)
+    bin_part = jnp.clip((mid * world / total).astype(jnp.int32), 0, world - 1)
+    if not ascending:
+        bin_part = (world - 1) - bin_part
+
+    rbin = jnp.clip(((fdata - gmin) / span * num_bins).astype(jnp.int32),
+                    0, num_bins - 1)
+    t = jnp.take(bin_part, rbin)
+    null_target = jnp.int32(0 if nulls_first else world - 1)
+    t = jnp.where(col.validity, t, null_target)
+    row_live = compact_mod.live_mask(cap, count)
+    return jnp.where(row_live, t, jnp.int32(world))

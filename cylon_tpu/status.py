@@ -1,0 +1,75 @@
+"""Status/error-code system.
+
+TPU-native analog of the reference's ``cylon::Status`` / ``cylon::Code``
+(reference: cpp/src/cylon/status.hpp, cpp/src/cylon/code.cpp).  The reference
+models its codes after Arrow's; we keep the same code set so messages and
+call-sites translate 1:1, but expose them Python-first (exceptions are the
+idiomatic failure path in a JAX framework; ``Status`` objects remain available
+for API parity with pycylon).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Code(enum.IntEnum):
+    """Error codes (reference: cpp/src/cylon/code.cpp)."""
+
+    OK = 0
+    OutOfMemory = 1
+    KeyError = 2
+    TypeError = 3
+    Invalid = 4
+    IOError = 5
+    CapacityError = 6
+    IndexError = 7
+    UnknownError = 9
+    NotImplemented = 10
+    SerializationError = 11
+    RError = 13
+    CodeGenError = 40
+    ExpressionValidationError = 41
+    ExecutionError = 42
+    AlreadyExists = 45
+
+
+@dataclass(frozen=True)
+class Status:
+    """Operation status (reference: cpp/src/cylon/status.hpp).
+
+    ``Status.OK()`` is success; anything else carries a code and message.
+    """
+
+    code: Code = Code.OK
+    msg: str = ""
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(Code.OK, "")
+
+    def is_ok(self) -> bool:
+        return self.code == Code.OK
+
+    def get_code(self) -> Code:
+        return self.code
+
+    def get_msg(self) -> str:
+        return self.msg
+
+    def __bool__(self) -> bool:
+        return self.is_ok()
+
+
+class CylonError(Exception):
+    """Exception raised by the Python-first API when an operation fails."""
+
+    def __init__(self, code: Code, msg: str):
+        super().__init__(f"[{code.name}] {msg}")
+        self.code = code
+        self.msg = msg
+
+
+def raise_not_ok(status: Status) -> None:
+    if not status.is_ok():
+        raise CylonError(status.code, status.msg)

@@ -1,0 +1,58 @@
+"""Test harness: simulate an 8-device TPU mesh on host CPU.
+
+The reference tests every distributed op at world sizes 1/2/4 via
+``mpirun --oversubscribe -np N`` (reference: cpp/test/CMakeLists.txt:19-50);
+the JAX equivalent is a virtual multi-device CPU platform, so the same
+shard_map programs that run on a TPU pod execute here on 8 host devices.
+
+Must run before anything imports jax: sets platform env, then neutralizes
+the container's axon TPU plugin (its sitecustomize claims the single real
+TPU grant per-process; tests must not touch it).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+assert "jax" not in sys.modules or os.environ["JAX_PLATFORMS"] == "cpu", (
+    "jax imported before conftest could force the CPU platform")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def local_ctx():
+    from cylon_tpu.context import CylonContext
+
+    return CylonContext.Init()
+
+
+def _dist_ctx(world):
+    from cylon_tpu.context import CylonContext, TPUConfig
+
+    return CylonContext.InitDistributed(TPUConfig(world_size=world))
+
+
+@pytest.fixture(scope="session")
+def ctx2():
+    return _dist_ctx(2)
+
+
+@pytest.fixture(scope="session")
+def ctx4():
+    return _dist_ctx(4)
+
+
+@pytest.fixture(scope="session")
+def ctx8():
+    return _dist_ctx(8)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

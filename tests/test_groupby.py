@@ -1,0 +1,97 @@
+"""Group-by tests: local + distributed two-phase, all aggregation ops.
+
+Mirrors the reference groupby suites (cpp/test/groupby_test.cpp,
+python/test/test_aggregate.py) with pandas as the golden engine.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table
+
+
+def _check(t, df, ops, ddof=0):
+    g = t.groupby("g", {"v": ops}, ddof=ddof).to_pandas().sort_values("g").reset_index(drop=True)
+    grp = df.groupby("g")["v"]
+    exp = {"sum": grp.sum(), "mean": grp.mean(), "count": grp.count(),
+           "min": grp.min(), "max": grp.max(), "var": grp.var(ddof=ddof),
+           "std": grp.std(ddof=ddof)}
+    for op in ops:
+        col = f"{'stddev' if op == 'std' else op}_v"
+        want = exp[op].sort_index().to_numpy(dtype=float)
+        got = g[col].to_numpy(dtype=float)
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12,
+                                   err_msg=f"op={op}")
+
+
+def test_local_groupby_all_ops(local_ctx, rng):
+    df = pd.DataFrame({"g": rng.integers(0, 7, 100), "v": rng.random(100)})
+    t = Table.from_pandas(df, ctx=local_ctx)
+    _check(t, df, ["sum", "mean", "count", "min", "max", "var", "std"])
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_distributed_groupby(request, rng, world):
+    ctx = request.getfixturevalue(f"ctx{world}")
+    df = pd.DataFrame({"g": rng.integers(0, 13, 400), "v": rng.random(400)})
+    t = Table.from_pandas(df, ctx=ctx)
+    _check(t, df, ["sum", "mean", "count", "min", "max", "var", "std"])
+
+
+def test_groupby_multi_key(local_ctx, rng):
+    df = pd.DataFrame({"g1": rng.integers(0, 4, 80), "g2": rng.integers(0, 4, 80),
+                       "v": rng.random(80)})
+    t = Table.from_pandas(df, ctx=local_ctx)
+    g = t.groupby(["g1", "g2"], {"v": "sum"}).to_pandas() \
+         .sort_values(["g1", "g2"]).reset_index(drop=True)
+    exp = df.groupby(["g1", "g2"])["v"].sum().reset_index()
+    np.testing.assert_allclose(g["sum_v"], exp["v"], rtol=1e-9)
+
+
+def test_groupby_int_values(local_ctx, rng):
+    df = pd.DataFrame({"g": rng.integers(0, 5, 60),
+                       "v": rng.integers(-100, 100, 60)})
+    t = Table.from_pandas(df, ctx=local_ctx)
+    g = t.groupby("g", {"v": ["sum", "min", "max"]}).to_pandas() \
+         .sort_values("g").reset_index(drop=True)
+    grp = df.groupby("g")["v"]
+    assert (g["sum_v"].to_numpy() == grp.sum().sort_index().to_numpy()).all()
+    assert (g["min_v"].to_numpy() == grp.min().sort_index().to_numpy()).all()
+    assert (g["max_v"].to_numpy() == grp.max().sort_index().to_numpy()).all()
+
+
+def test_groupby_nunique_local(local_ctx):
+    df = pd.DataFrame({"g": [1, 1, 1, 2, 2], "v": [5, 5, 6, 7, 7]})
+    t = Table.from_pandas(df, ctx=local_ctx)
+    g = t.groupby("g", {"v": "nunique"}).to_pandas().sort_values("g")
+    assert g["nunique_v"].tolist() == [2, 1]
+
+
+def test_groupby_nulls_excluded(local_ctx):
+    pa = pytest.importorskip("pyarrow")
+    at = pa.table({"g": pa.array([1, 1, 2, 2]),
+                   "v": pa.array([1.0, None, 3.0, None])})
+    t = Table.from_arrow(at, ctx=local_ctx)
+    g = t.groupby("g", {"v": ["sum", "count", "mean"]}).to_pandas().sort_values("g")
+    assert g["count_v"].tolist() == [1, 1]
+    assert g["sum_v"].tolist() == [1.0, 3.0]
+
+
+def test_pipeline_groupby_on_sorted(local_ctx):
+    """reference: DistributedPipelineGroupBy assumes key-sorted input."""
+    from cylon_tpu.ops import groupby as gmod
+    import jax.numpy as jnp
+
+    df = pd.DataFrame({"g": [1, 1, 2, 3, 3, 3], "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]})
+    t = Table.from_pandas(df, ctx=local_ctx)
+    cols, m = gmod.pipeline_groupby(t.columns, t.row_counts[0], (0,),
+                                    ((1, gmod.AggOp.SUM),))
+    assert int(m) == 3
+    np.testing.assert_allclose(np.asarray(cols[1].data[:3]), [3.0, 3.0, 15.0])
+
+
+def test_groupby_single_group(local_ctx):
+    t = Table.from_pydict({"g": [7, 7, 7], "v": [1.0, 2.0, 3.0]}, ctx=local_ctx)
+    g = t.groupby("g", {"v": "mean"})
+    assert g.row_count == 1
+    assert g.to_pydict()["mean_v"] == [2.0]

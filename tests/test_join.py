@@ -1,0 +1,141 @@
+"""Join tests: all join types, local + distributed, world sizes 1/2/4/8.
+
+Mirrors the reference join suite (cpp/test/join_test.cpp, run at -np 1/2/4
+by cylon_run_test) with pandas.merge as the golden engine.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import JoinConfig, Table
+
+from .utils import rows_multiset
+
+HOWS = ["inner", "left", "right", "outer"]
+
+
+def _golden(pl, pr, how):
+    how_pd = "outer" if how == "outer" else how
+    return pl.merge(pr, on="k", how=how_pd)
+
+
+def _make(rng, n, nkeys, vcol):
+    return pd.DataFrame({"k": rng.integers(0, nkeys, n),
+                         vcol: rng.random(n)})
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_local_join_types(local_ctx, rng, how):
+    pl = _make(rng, 50, 10, "x")
+    pr = _make(rng, 40, 12, "y")
+    l = Table.from_pandas(pl, ctx=local_ctx)
+    r = Table.from_pandas(pr, ctx=local_ctx)
+    j = l.join(r, on="k", how=how).to_pandas()
+    exp = _golden(pl, pr, how)
+    got = [(a if pd.notna(a) else None, b if pd.notna(b) else None,
+            round(c, 9) if pd.notna(c) else None)
+           for a, b, c in zip(
+               j["l_k"].where(pd.notna(j["l_k"]), None),
+               j["r_k"].where(pd.notna(j["r_k"]), None),
+               j["x"].where(pd.notna(j["x"]), None))]
+    assert len(j) == len(exp)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_local_join_content(local_ctx, rng, how):
+    pl = _make(rng, 60, 8, "x")
+    pr = _make(rng, 45, 8, "y")
+    l = Table.from_pandas(pl, ctx=local_ctx)
+    r = Table.from_pandas(pr, ctx=local_ctx)
+    j = l.join(r, on="k", how=how).to_pandas()
+    exp = _golden(pl, pr, how)
+    # compare (k_left-or-right, x, y) multisets
+    jk = j["l_k"].fillna(j["r_k"])
+    ek = exp["k"]
+    got = rows_multiset(pd.DataFrame({"k": jk, "x": j["x"], "y": j["y"]}))
+    want = rows_multiset(pd.DataFrame({"k": ek, "x": exp["x"], "y": exp["y"]}))
+    assert got == want
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("how", HOWS)
+def test_distributed_join(request, rng, world, how):
+    ctx = request.getfixturevalue(f"ctx{world}")
+    pl = _make(rng, 200, 30, "x")
+    pr = _make(rng, 150, 30, "y")
+    l = Table.from_pandas(pl, ctx=ctx)
+    r = Table.from_pandas(pr, ctx=ctx)
+    j = l.distributed_join(r, on="k", how=how).to_pandas()
+    exp = _golden(pl, pr, how)
+    jk = j["l_k"].fillna(j["r_k"])
+    got = rows_multiset(pd.DataFrame({"k": jk, "x": j["x"], "y": j["y"]}))
+    want = rows_multiset(pd.DataFrame({"k": exp["k"], "x": exp["x"], "y": exp["y"]}))
+    assert got == want
+
+
+def test_multi_column_key(local_ctx, rng):
+    pl = pd.DataFrame({"k1": rng.integers(0, 5, 50), "k2": rng.integers(0, 5, 50),
+                       "x": rng.random(50)})
+    pr = pd.DataFrame({"k1": rng.integers(0, 5, 40), "k2": rng.integers(0, 5, 40),
+                       "y": rng.random(40)})
+    l = Table.from_pandas(pl, ctx=local_ctx)
+    r = Table.from_pandas(pr, ctx=local_ctx)
+    j = l.join(r, left_on=["k1", "k2"], right_on=["k1", "k2"], how="inner").to_pandas()
+    exp = pl.merge(pr, on=["k1", "k2"], how="inner")
+    assert len(j) == len(exp)
+    got = rows_multiset(pd.DataFrame({"a": j["l_k1"], "b": j["l_k2"],
+                                      "x": j["x"], "y": j["y"]}))
+    want = rows_multiset(exp[["k1", "k2", "x", "y"]])
+    assert got == want
+
+
+def test_string_key_join(local_ctx):
+    pl = pd.DataFrame({"k": ["apple", "pear", "plum", "apple"], "x": [1.0, 2.0, 3.0, 4.0]})
+    pr = pd.DataFrame({"k": ["apple", "fig"], "y": [9.0, 8.0]})
+    l = Table.from_pandas(pl, ctx=local_ctx)
+    r = Table.from_pandas(pr, ctx=local_ctx)
+    j = l.join(r, on="k", how="inner").to_pandas()
+    assert sorted(j["x"]) == [1.0, 4.0]
+    assert set(j["l_k"]) == {"apple"}
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_distributed_string_key_join(request, rng, world):
+    ctx = request.getfixturevalue(f"ctx{world}")
+    keys = np.array([f"key_{i:03d}" for i in range(20)])
+    pl = pd.DataFrame({"k": rng.choice(keys, 100), "x": rng.random(100)})
+    pr = pd.DataFrame({"k": rng.choice(keys, 80), "y": rng.random(80)})
+    l = Table.from_pandas(pl, ctx=ctx)
+    r = Table.from_pandas(pr, ctx=ctx)
+    j = l.distributed_join(r, on="k", how="inner").to_pandas()
+    exp = pl.merge(pr, on="k", how="inner")
+    got = rows_multiset(pd.DataFrame({"k": j["l_k"], "x": j["x"], "y": j["y"]}))
+    want = rows_multiset(exp[["k", "x", "y"]])
+    assert got == want
+
+
+def test_join_config_parity(local_ctx, rng):
+    """Reference-style JoinConfig objects (join_config.hpp factories)."""
+    pl = _make(rng, 30, 6, "x")
+    pr = _make(rng, 30, 6, "y")
+    l = Table.from_pandas(pl, ctx=local_ctx)
+    r = Table.from_pandas(pr, ctx=local_ctx)
+    cfg = JoinConfig.InnerJoin(left_on="k", right_on="k", algorithm="hash")
+    j = l.join(r, cfg)
+    assert j.row_count == len(pl.merge(pr, on="k", how="inner"))
+
+
+def test_join_no_matches(local_ctx):
+    l = Table.from_pydict({"k": [1, 2], "x": [1.0, 2.0]}, ctx=local_ctx)
+    r = Table.from_pydict({"k": [5, 6], "y": [3.0, 4.0]}, ctx=local_ctx)
+    assert l.join(r, on="k", how="inner").row_count == 0
+    assert l.join(r, on="k", how="left").row_count == 2
+    assert l.join(r, on="k", how="right").row_count == 2
+    assert l.join(r, on="k", how="outer").row_count == 4
+
+
+def test_join_with_duplicates_both_sides(local_ctx):
+    l = Table.from_pydict({"k": [1, 1, 1], "x": [1.0, 2.0, 3.0]}, ctx=local_ctx)
+    r = Table.from_pydict({"k": [1, 1], "y": [10.0, 20.0]}, ctx=local_ctx)
+    j = l.join(r, on="k", how="inner")
+    assert j.row_count == 6

@@ -1,0 +1,39 @@
+"""Test helpers: golden-table comparison.
+
+The reference verifies ops by multiset-subtracting results against golden
+tables (cpp/test/test_utils.hpp:29-51 ``Subtract(result, expected) == 0``);
+here the golden engine is pandas/pyarrow and equality is sorted-row
+comparison with rounding for floats.
+"""
+import numpy as np
+import pandas as pd
+
+
+def rows_multiset(df: pd.DataFrame, ndigits: int = 9):
+    def norm(v):
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            return None
+        if isinstance(v, (float, np.floating)):
+            return round(float(v), ndigits)
+        if isinstance(v, (np.integer,)):
+            return int(v)
+        return v
+
+    return sorted(tuple(norm(v) for v in row) for row in df.itertuples(index=False))
+
+
+def assert_table_equals(table, expected: pd.DataFrame, ndigits: int = 9):
+    got = table.to_pandas()
+    assert list(got.columns) == list(expected.columns), (
+        f"columns {list(got.columns)} != {list(expected.columns)}")
+    g, e = rows_multiset(got, ndigits), rows_multiset(expected, ndigits)
+    assert g == e, f"rows differ:\n got={g[:10]}...\n exp={e[:10]}..."
+
+
+def assert_rows_equal(table, expected: pd.DataFrame, ndigits: int = 6):
+    """Order- and name-insensitive content comparison."""
+    got = table.to_pandas()
+    assert got.shape[0] == expected.shape[0], f"{got.shape} vs {expected.shape}"
+    g = rows_multiset(got, ndigits)
+    e = rows_multiset(expected, ndigits)
+    assert g == e
