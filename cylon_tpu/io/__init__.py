@@ -1,0 +1,8 @@
+"""IO layer: CSV/Parquet ingest + egress (reference: cpp/src/cylon/io/)."""
+from .arrow_io import read_csv, read_parquet, write_csv, write_parquet
+from .csv_config import CSVReadOptions, CSVWriteOptions, ParquetOptions
+
+__all__ = [
+    "read_csv", "read_parquet", "write_csv", "write_parquet",
+    "CSVReadOptions", "CSVWriteOptions", "ParquetOptions",
+]

@@ -1,0 +1,117 @@
+"""CSV / Parquet ingest and egress.
+
+TPU-native analog of the reference's IO layer (reference:
+cpp/src/cylon/io/arrow_io.cpp:33-116 read_csv/ReadParquet/WriteParquet and
+the Table factory paths cpp/src/cylon/table.cpp:803-855 FromCSV /
+:1049-1132 FromParquet/WriteParquet):
+
+- parsing is pyarrow (the reference wraps Arrow's CSV/Parquet readers the
+  same way), producing host Arrow tables;
+- device placement pads columns to static capacities and lays shard i of a
+  distributed table on mesh position i (cylon_tpu.table internals);
+- multi-file reads fan out over a thread pool when
+  ``options.ConcurrentFileReads`` (reference: table.cpp:824-844 spawns a
+  std::thread + promise/future per file).
+
+Distribution semantics:
+- one path + distributed ctx  -> rows split contiguously across shards
+- list of paths (len == world) -> file i becomes shard i, read concurrently
+"""
+from __future__ import annotations
+
+import concurrent.futures as _futures
+from typing import List, Optional, Sequence, Union
+
+from ..status import Code, CylonError
+from .csv_config import CSVReadOptions, CSVWriteOptions, ParquetOptions
+
+PathLike = Union[str, "os.PathLike[str]"]
+
+
+def _read_csv_arrow(path: PathLike, options: CSVReadOptions):
+    import pyarrow.csv as pc
+
+    read, parse, convert = options.to_pyarrow()
+    return pc.read_csv(str(path), read_options=read, parse_options=parse,
+                       convert_options=convert)
+
+
+def _read_parquet_arrow(path: PathLike):
+    import pyarrow.parquet as pq
+
+    return pq.read_table(str(path))
+
+
+def _read_many(paths: Sequence[PathLike], reader, concurrent: bool):
+    """Concurrent multi-file read (reference: table.cpp:824-844)."""
+    if not paths:
+        raise CylonError(Code.Invalid, "no input files")
+    if not concurrent or len(paths) == 1:
+        return [reader(p) for p in paths]
+    with _futures.ThreadPoolExecutor(max_workers=len(paths)) as pool:
+        return list(pool.map(reader, paths))
+
+
+def read_csv(paths: Union[PathLike, Sequence[PathLike]],
+             options: Optional[CSVReadOptions] = None, ctx=None,
+             capacity: Optional[int] = None):
+    """Read CSV file(s) into a (possibly distributed) Table
+    (reference: io::read_csv, io/arrow_io.cpp:33-61 + Table::FromCSV)."""
+    from ..context import default_context
+    from ..table import _table_from_arrow_tables
+
+    options = options or CSVReadOptions()
+    ctx = ctx or default_context()
+    if isinstance(paths, (list, tuple)):
+        atables = _read_many(paths, lambda p: _read_csv_arrow(p, options),
+                             options.concurrent_file_reads)
+        return _table_from_arrow_tables(atables, ctx, capacity,
+                                        per_shard=True,
+                                        string_width=options.string_width)
+    atable = _read_csv_arrow(paths, options)
+    return _table_from_arrow_tables([atable], ctx, capacity, per_shard=False,
+                                    string_width=options.string_width)
+
+
+def read_parquet(paths: Union[PathLike, Sequence[PathLike]],
+                 options: Optional[ParquetOptions] = None, ctx=None,
+                 capacity: Optional[int] = None):
+    """reference: io::ReadParquet (io/arrow_io.cpp:65-91), Table::FromParquet
+    (table.cpp:1049-1116)."""
+    from ..context import default_context
+    from ..table import _table_from_arrow_tables
+
+    options = options or ParquetOptions()
+    ctx = ctx or default_context()
+    if isinstance(paths, (list, tuple)):
+        atables = _read_many(paths, _read_parquet_arrow,
+                             options.concurrent_file_reads)
+        return _table_from_arrow_tables(atables, ctx, capacity,
+                                        per_shard=True,
+                                        string_width=options.string_width)
+    atable = _read_parquet_arrow(paths)
+    return _table_from_arrow_tables([atable], ctx, capacity, per_shard=False,
+                                    string_width=options.string_width)
+
+
+def write_csv(table, path: PathLike,
+              options: Optional[CSVWriteOptions] = None) -> None:
+    """Gathered CSV write (reference: Table::WriteCSV, table.cpp:243-256)."""
+    options = options or CSVWriteOptions()
+    df = table.to_pandas()
+    if options.column_names is not None:
+        if len(options.column_names) != len(df.columns):
+            raise CylonError(Code.Invalid, "column_names length mismatch")
+        df.columns = options.column_names
+    df.to_csv(str(path), sep=options.delimiter, index=False)
+
+
+def write_parquet(table, path: PathLike,
+                  options: Optional[ParquetOptions] = None) -> None:
+    """reference: io::WriteParquet (io/arrow_io.cpp:94-116,
+    table.cpp:1118-1131)."""
+    import pyarrow.parquet as pq
+
+    options = options or ParquetOptions()
+    pq.write_table(table.to_arrow(), str(path),
+                   row_group_size=options.chunk_size)

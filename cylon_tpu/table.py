@@ -159,6 +159,35 @@ class Table:
         return _table_from_arrow(arrays, ctx or default_context(), capacity)
 
     @staticmethod
+    def from_csv(paths, options=None, ctx: Optional[CylonContext] = None,
+                 capacity: Optional[int] = None) -> "Table":
+        """Read CSV file(s); a list of paths maps file i -> shard i
+        (reference: Table::FromCSV, table.cpp:803-855)."""
+        from . import io as io_mod
+
+        return io_mod.read_csv(paths, options, ctx, capacity)
+
+    @staticmethod
+    def from_parquet(paths, options=None, ctx: Optional[CylonContext] = None,
+                     capacity: Optional[int] = None) -> "Table":
+        """reference: Table::FromParquet (table.cpp:1049-1116)."""
+        from . import io as io_mod
+
+        return io_mod.read_parquet(paths, options, ctx, capacity)
+
+    def to_csv(self, path, options=None) -> None:
+        """reference: Table::WriteCSV (table.cpp:243-256)."""
+        from . import io as io_mod
+
+        io_mod.write_csv(self, path, options)
+
+    def to_parquet(self, path, options=None) -> None:
+        """reference: Table::WriteParquet (table.cpp:1118-1131)."""
+        from . import io as io_mod
+
+        io_mod.write_parquet(self, path, options)
+
+    @staticmethod
     def from_numpy(names: Sequence[str], arrays: Sequence[np.ndarray],
                    ctx: Optional[CylonContext] = None,
                    capacity: Optional[int] = None) -> "Table":
@@ -642,9 +671,13 @@ def _table_from_numpy(arrays: Dict[str, np.ndarray], ctx: CylonContext,
 
 
 def _table_from_arrow(arrays: Dict[str, object], ctx: CylonContext,
-                      capacity: Optional[int]) -> Table:
+                      capacity: Optional[int],
+                      string_width: Optional[int] = None) -> Table:
     import pyarrow as pa
 
+    from .column import DEFAULT_STRING_WIDTH
+
+    sw = string_width or DEFAULT_STRING_WIDTH
     names = tuple(arrays.keys())
     vals = []
     for a in arrays.values():
@@ -655,14 +688,80 @@ def _table_from_arrow(arrays: Dict[str, object], ctx: CylonContext,
     world = ctx.GetWorldSize()
     if world == 1:
         cap = capacity or max(8, n)
-        cols = tuple(column_mod.from_arrow(a, capacity=cap) for a in vals)
+        cols = tuple(column_mod.from_arrow(a, capacity=cap, string_width=sw)
+                     for a in vals)
         return Table(cols, jnp.asarray([n], jnp.int32), names, ctx)
     chunk, counts, shard_cap = _shard_plan(n, world, capacity)
     cols = []
     for a in vals:
         shard_cols = [column_mod.from_arrow(a.slice(s * chunk, counts[s]),
-                                            capacity=shard_cap)
+                                            capacity=shard_cap, string_width=sw)
                       for s in range(world)]
+        cols.append(_assemble_sharded(shard_cols, ctx))
+    return Table(tuple(cols), _sharded_counts(counts, ctx), names, ctx)
+
+
+def _table_from_arrow_tables(atables, ctx: CylonContext,
+                             capacity: Optional[int], *, per_shard: bool,
+                             string_width: Optional[int] = None) -> Table:
+    """Build a Table from host Arrow tables.
+
+    per_shard=True: table i becomes mesh shard i (the reference's
+    one-file-per-rank FromCSV semantics, table.cpp:810-855); requires
+    ``len(atables) == world``.  per_shard=False: a single table whose rows
+    are split contiguously across shards.
+    """
+    import pyarrow as pa
+
+    from .column import DEFAULT_STRING_WIDTH
+
+    sw = string_width or DEFAULT_STRING_WIDTH
+    if not atables:
+        raise CylonError(Code.Invalid, "no input files")
+    names = tuple(atables[0].column_names)
+    schema0 = atables[0].schema
+    for i, at in enumerate(atables[1:], 1):
+        if tuple(at.column_names) != names:
+            raise CylonError(Code.Invalid,
+                             f"schema mismatch across files: {at.column_names} "
+                             f"vs {list(names)}")
+        if at.schema != schema0:
+            # unify inferred types (int64 in one file, double in another)
+            # rather than corrupting buffers downstream
+            try:
+                import pyarrow as pa
+
+                unified = pa.unify_schemas([schema0, at.schema],
+                                           promote_options="permissive")
+                atables = [t.cast(unified) for t in atables]
+                schema0 = unified
+            except Exception as e:
+                raise CylonError(
+                    Code.Invalid,
+                    f"column type mismatch between file 0 and file {i}: "
+                    f"{schema0} vs {at.schema}") from e
+    world = ctx.GetWorldSize()
+    if not per_shard or world == 1:
+        combined = pa.concat_tables(atables) if len(atables) > 1 else atables[0]
+        arrays = {n: combined.column(n) for n in names}
+        return _table_from_arrow(arrays, ctx, capacity, string_width=sw)
+    if len(atables) != world:
+        raise CylonError(Code.Invalid,
+                         f"{len(atables)} files for a {world}-shard mesh; "
+                         "per-shard reads need one file per mesh position")
+    counts = [at.num_rows for at in atables]
+    shard_cap = capacity // world if capacity else max(8, max(counts))
+    if shard_cap < max(counts):
+        big = counts.index(max(counts))
+        raise CylonError(
+            Code.Invalid,
+            f"capacity {capacity} gives {shard_cap} rows per shard but file "
+            f"{big} has {counts[big]} rows")
+    cols = []
+    for name in names:
+        shard_cols = [column_mod.from_arrow(at.column(name), capacity=shard_cap,
+                                            string_width=sw)
+                      for at in atables]
         cols.append(_assemble_sharded(shard_cols, ctx))
     return Table(tuple(cols), _sharded_counts(counts, ctx), names, ctx)
 

@@ -18,8 +18,17 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
-assert "jax" not in sys.modules or os.environ["JAX_PLATFORMS"] == "cpu", (
-    "jax imported before conftest could force the CPU platform")
+import jax  # noqa: E402
+
+# The container's axon sitecustomize registers the TPU PJRT plugin at
+# interpreter boot and calls jax.config.update("jax_platforms", "axon,cpu"),
+# which silently overrides the JAX_PLATFORMS env var.  Force the config back
+# to cpu-only BEFORE any backend initializes, or every "distributed" context
+# would get the single real TPU chip (world_size 1) and the multi-shard code
+# paths would never execute.
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu"
+assert len(jax.devices()) == 8, jax.devices()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,122 @@
+"""CSV/Parquet IO — local + distributed, option builders, multi-file reads.
+
+Mirrors cpp/test/create_table_test.cpp + python/test/test_csv_read_options
+coverage of the reference (io/arrow_io.cpp, table.cpp FromCSV/FromParquet).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table
+from cylon_tpu.io import CSVReadOptions, CSVWriteOptions
+
+
+def _frame(rng, n=60):
+    return pd.DataFrame({
+        "id": np.arange(n, dtype=np.int64),
+        "v": rng.random(n),
+        "name": [f"row_{i % 7}" for i in range(n)],
+    })
+
+
+def test_csv_roundtrip_local(tmp_path, local_ctx, rng):
+    df = _frame(rng)
+    p = tmp_path / "t.csv"
+    df.to_csv(p, index=False)
+    t = Table.from_csv(p, ctx=local_ctx)
+    assert t.row_count == len(df)
+    assert t.column_names == ["id", "v", "name"]
+    got = t.to_pandas()
+    pd.testing.assert_frame_equal(got, df)
+
+    out = tmp_path / "out.csv"
+    t.to_csv(out)
+    pd.testing.assert_frame_equal(pd.read_csv(out), df)
+
+
+def test_csv_options_delimiter_and_types(tmp_path, local_ctx, rng):
+    df = _frame(rng, 20)
+    p = tmp_path / "t.psv"
+    df.to_csv(p, index=False, sep="|")
+    opts = (CSVReadOptions().WithDelimiter("|").UseThreads(False)
+            .WithColumnTypes({"id": np.int32}))
+    t = Table.from_csv(p, options=opts, ctx=local_ctx)
+    assert t.columns[0].data.dtype == np.int32
+    assert t.row_count == len(df)
+
+    out = tmp_path / "o.psv"
+    t.to_csv(out, options=CSVWriteOptions().WithDelimiter("|"))
+    got = pd.read_csv(out, sep="|")
+    assert list(got.columns) == list(df.columns)
+    assert len(got) == len(df)
+
+
+def test_csv_null_values(tmp_path, local_ctx):
+    p = tmp_path / "t.csv"
+    p.write_text("a,b\n1,x\nNA,y\n3,NA\n")
+    opts = CSVReadOptions().NullValues(["NA"]).StringsCanBeNull()
+    t = Table.from_csv(p, options=opts, ctx=local_ctx)
+    d = t.to_pydict()
+    assert d["a"] == [1, None, 3]
+    assert d["b"] == ["x", "y", None]
+
+
+def test_csv_distributed_single_file(tmp_path, ctx4, rng):
+    df = _frame(rng, 101)
+    p = tmp_path / "t.csv"
+    df.to_csv(p, index=False)
+    t = Table.from_csv(p, ctx=ctx4)
+    assert t.num_shards == 4
+    assert t.row_count == len(df)
+    pd.testing.assert_frame_equal(t.to_pandas(), df)
+
+
+def test_csv_multi_file_per_shard(tmp_path, ctx4, rng):
+    paths, frames = [], []
+    for s in range(4):
+        df = _frame(rng, 10 + 3 * s)
+        p = tmp_path / f"part_{s}.csv"
+        df.to_csv(p, index=False)
+        paths.append(p)
+        frames.append(df)
+    t = Table.from_csv(paths, ctx=ctx4)
+    assert t.num_shards == 4
+    counts = np.asarray(t.row_counts)
+    assert list(counts) == [len(f) for f in frames]
+    pd.testing.assert_frame_equal(
+        t.to_pandas(), pd.concat(frames, ignore_index=True))
+
+
+def test_csv_multi_file_wrong_count(tmp_path, ctx4, rng):
+    df = _frame(rng, 10)
+    p = tmp_path / "one.csv"
+    df.to_csv(p, index=False)
+    from cylon_tpu import CylonError
+
+    with pytest.raises(CylonError):
+        Table.from_csv([p, p], ctx=ctx4)
+
+
+def test_parquet_roundtrip(tmp_path, local_ctx, rng):
+    df = _frame(rng, 44)
+    p = tmp_path / "t.parquet"
+    df.to_parquet(p)
+    t = Table.from_parquet(p, ctx=local_ctx)
+    pd.testing.assert_frame_equal(t.to_pandas(), df)
+    out = tmp_path / "o.parquet"
+    t.to_parquet(out)
+    pd.testing.assert_frame_equal(pd.read_parquet(out), df)
+
+
+def test_parquet_multi_file_distributed(tmp_path, ctx2, rng):
+    frames, paths = [], []
+    for s in range(2):
+        df = _frame(rng, 15 + s)
+        p = tmp_path / f"p{s}.parquet"
+        df.to_parquet(p)
+        frames.append(df)
+        paths.append(p)
+    t = Table.from_parquet(paths, ctx=ctx2)
+    assert t.row_count == sum(len(f) for f in frames)
+    pd.testing.assert_frame_equal(
+        t.to_pandas(), pd.concat(frames, ignore_index=True))
