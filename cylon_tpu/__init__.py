@@ -26,18 +26,26 @@ import jax as _jax
 # to TPU-friendly widths (uint32 hashes, int32 indices) explicitly.
 _jax.config.update("jax_enable_x64", True)
 
+from . import compute
 from . import dtypes
+from . import io
 from .column import Column
 from .config import JoinAlgorithm, JoinConfig, JoinType, SortOptions
 from .context import CommType, CylonContext, LocalConfig, TPUConfig
+from .frame import DataFrame
+from .index import (CategoricalIndex, ColumnIndex, Index, Int64Index,
+                    IntegerIndex, NumericIndex, RangeIndex)
 from .ops.groupby import AggOp
+from .series import Series
 from .status import Code, CylonError, Status
 from .table import Table
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "Table", "Column", "CylonContext", "TPUConfig", "LocalConfig", "CommType",
-    "JoinConfig", "JoinType", "JoinAlgorithm", "SortOptions", "AggOp",
-    "Status", "Code", "CylonError", "dtypes", "__version__",
+    "Table", "DataFrame", "Series", "Column", "CylonContext", "TPUConfig",
+    "LocalConfig", "CommType", "JoinConfig", "JoinType", "JoinAlgorithm",
+    "SortOptions", "AggOp", "Status", "Code", "CylonError", "dtypes", "io",
+    "compute", "Index", "RangeIndex", "NumericIndex", "IntegerIndex",
+    "Int64Index", "CategoricalIndex", "ColumnIndex", "__version__",
 ]

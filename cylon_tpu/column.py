@@ -125,8 +125,12 @@ def from_numpy(values: np.ndarray, *, validity: Optional[np.ndarray] = None,
     n = len(values)
     cap = _next_capacity(n, capacity)
     if values.dtype.kind in ("U", "S", "O"):
-        enc = [v if isinstance(v, bytes) else str(v).encode("utf-8")
-               for v in values]
+        # None / nan entries are nulls (pandas object-column missing values)
+        missing = np.array([v is None or (isinstance(v, float) and np.isnan(v))
+                            for v in values], bool) if n else np.zeros((0,), bool)
+        enc = [b"" if missing[i]
+               else (v if isinstance(v, bytes) else str(v).encode("utf-8"))
+               for i, v in enumerate(values)]
         width = max([string_width] + [len(b) for b in enc]) if enc else string_width
         mat = np.zeros((cap, width), np.uint8)
         lens = np.zeros((cap,), np.int32)
@@ -134,19 +138,25 @@ def from_numpy(values: np.ndarray, *, validity: Optional[np.ndarray] = None,
             mat[i, : len(b)] = np.frombuffer(b, np.uint8)
             lens[i] = len(b)
         valid = np.zeros((cap,), bool)
-        valid[:n] = True if validity is None else validity[:n]
+        valid[:n] = ~missing if validity is None else validity[:n]
         dt = dtype or dtypes.string
         return Column(jnp.asarray(mat), jnp.asarray(valid), jnp.asarray(lens), dt)
     if values.dtype.kind == "M":
         # datetime64 -> int64 microseconds (Arrow timestamp physical layout)
+        if validity is None:
+            validity = ~np.isnat(values)
         values = values.astype("datetime64[us]").astype(np.int64)
         dt = dtype or dtypes.timestamp("us")
     else:
         dt = dtype or dtypes.from_numpy_dtype(values.dtype)
+    if validity is None and values.dtype.kind == "f":
+        # NaN = missing, matching Arrow/pandas ingestion semantics
+        validity = ~np.isnan(values)
     buf = np.zeros((cap,), values.dtype)
     buf[:n] = values
     valid = np.zeros((cap,), bool)
     valid[:n] = True if validity is None else validity[:n]
+    buf[:n] = np.where(valid[:n], buf[:n], np.zeros((), values.dtype))
     return Column(jnp.asarray(buf), jnp.asarray(valid), None, dt)
 
 

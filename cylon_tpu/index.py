@@ -1,0 +1,127 @@
+"""Index hierarchy for the DataFrame facade.
+
+TPU-native analog of PyCylon's index classes (reference:
+python/pycylon/index.py:22-221 — Index / NumericIndex / IntegerIndex /
+RangeIndex / CategoricalIndex / ColumnIndex plus resolution helpers).
+Row identity in a mesh-sharded table is positional; RangeIndex is the
+default and a ColumnIndex records which column plays the index role.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class Index:
+    """Base index (reference: index.py:22-33)."""
+
+    def __init__(self, data=None):
+        self._data = data
+
+    def initialize(self) -> None:
+        pass
+
+    @property
+    def index(self) -> "Index":
+        return self
+
+    @property
+    def index_values(self):
+        return self._data
+
+    def __len__(self) -> int:
+        v = self.index_values
+        return 0 if v is None else len(v)
+
+
+class NumericIndex(Index):
+    """reference: index.py:36-56."""
+
+    def __init__(self, data):
+        super().__init__(np.asarray(data))
+
+    @Index.index_values.getter
+    def index_values(self):
+        return self._data
+
+    @index_values.setter
+    def index_values(self, data):
+        self._data = np.asarray(data)
+
+
+class IntegerIndex(NumericIndex):
+    """reference: index.py:59-66."""
+
+
+class Int64Index(IntegerIndex):
+    pass
+
+
+class RangeIndex(Index):
+    """Positional row index (reference: index.py:69-95)."""
+
+    def __init__(self, start: int = 0, stop: int = 0, step: int = 1):
+        super().__init__(None)
+        if isinstance(start, range):
+            rng = start
+            start, stop, step = rng.start, rng.stop, rng.step
+        self._start, self._stop, self._step = start, stop, step
+
+    @property
+    def start(self) -> int:
+        return self._start
+
+    @start.setter
+    def start(self, v: int) -> None:
+        self._start = v
+
+    @property
+    def stop(self) -> int:
+        return self._stop
+
+    @stop.setter
+    def stop(self, v: int) -> None:
+        self._stop = v
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    @step.setter
+    def step(self, v: int) -> None:
+        self._step = v
+
+    @property
+    def index_values(self):
+        return np.arange(self._start, self._stop, self._step)
+
+    def __len__(self) -> int:
+        return max(0, (self._stop - self._start + self._step - 1) // self._step)
+
+
+class CategoricalIndex(Index):
+    """reference: index.py:106-115."""
+
+    def __init__(self, key):
+        super().__init__(key)
+
+    @property
+    def index_values(self):
+        return self._data
+
+
+class ColumnIndex(Index):
+    """A named column acting as the index (reference: index.py:117-124)."""
+
+    def __init__(self, key):
+        super().__init__(key)
+
+    @property
+    def index_values(self):
+        return self._data
+
+
+def range_calculator(index: Index) -> int:
+    """reference: index.py resolution helper."""
+    return len(index)

@@ -467,6 +467,229 @@ class Table:
 
         return par_ops.distributed_scalar_agg(self, ci, op)
 
+    # ------------------------------------------------------------------
+    # element-wise compute surface (pycylon table.pyx:1026-1598 dunders,
+    # 1599-2146 fillna/where/isnull/dropna/isin; data/compute.pyx kernels)
+    # ------------------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, (str, int, np.integer)):
+            return self.project([key])
+        if isinstance(key, (list, tuple)):
+            return self.project(list(key))
+        if isinstance(key, Table):
+            return self.filter(key)
+        if isinstance(key, slice):
+            return self._row_slice(key)
+        raise CylonError(Code.Invalid, f"bad Table key {key!r}")
+
+    def __setitem__(self, key: str, value) -> None:
+        if not isinstance(key, str):
+            raise CylonError(Code.Invalid, "column name must be a string")
+        col = self._column_from_value(value)
+        if key in self.names:
+            i = self.names.index(key)
+            self.columns = self.columns[:i] + (col,) + self.columns[i + 1:]
+        else:
+            self.columns = self.columns + (col,)
+            self.names = self.names + (key,)
+
+    def _column_from_value(self, value) -> Column:
+        from . import compute as compute_mod
+
+        if isinstance(value, Column):
+            if value.capacity != self.capacity:
+                raise CylonError(Code.Invalid, "column capacity mismatch")
+            return value
+        if isinstance(value, Table):
+            if len(value.columns) != 1:
+                raise CylonError(Code.Invalid, "expected a single-column table")
+            return self._column_from_value(value.columns[0])
+        if np.isscalar(value) or isinstance(value, (bool, int, float, str)):
+            n = self.row_count
+            return self._column_from_value(np.full((n,), value))
+        arr = np.asarray(value)
+        if arr.shape[0] != self.row_count:
+            raise CylonError(Code.Invalid,
+                             f"value length {arr.shape[0]} != rows {self.row_count}")
+        if self.num_shards == 1:
+            return column_mod.from_numpy(arr, capacity=self.capacity)
+        counts = np.asarray(jax.device_get(self.row_counts))
+        cap = self.shard_capacity
+        off = 0
+        shard_cols = []
+        for s in range(self.num_shards):
+            shard_cols.append(column_mod.from_numpy(
+                arr[off: off + int(counts[s])], capacity=cap))
+            off += int(counts[s])
+        return _assemble_sharded(shard_cols, self.ctx)
+
+    def _row_slice(self, sl: slice) -> "Table":
+        if self.num_shards != 1:
+            raise CylonError(Code.Invalid,
+                             "row slicing requires a local (1-shard) table")
+        start, stop, step = sl.indices(self.row_count)
+        idx = jnp.arange(start, stop, step, dtype=jnp.int32)
+        n = idx.shape[0]
+        cap = max(8, n)
+        pad_idx = jnp.concatenate(
+            [idx, jnp.zeros((cap - n,), jnp.int32)]) if cap > n else idx
+        from .ops import compact as compact_mod
+
+        mask = compact_mod.live_mask(cap, jnp.asarray(n, jnp.int32))
+        cols = tuple(c.take(pad_idx, valid_mask=mask) for c in self.columns)
+        return Table(cols, jnp.asarray([n], jnp.int32), self.names, self.ctx)
+
+    def filter(self, mask: "Table") -> "Table":
+        """Row filter by a boolean table (pandas-style ``df[bool_mask]``;
+        reference: table.pyx:991-1024 filter / c_filter compute.pyx:29-39)."""
+        from .ops import compact as compact_mod
+
+        if len(mask.columns) != 1:
+            raise CylonError(Code.Invalid, "filter mask must have one column")
+        if mask.columns[0].dtype.type != dtypes.Type.BOOL:
+            raise CylonError(Code.Invalid, "filter mask must be boolean")
+        names, ctx = self.names, self.ctx
+
+        def fn(t: Table, m: Table) -> Table:
+            cap = t.columns[0].data.shape[0]
+            mc = m.columns[0]
+            keep = mc.data & mc.validity & compact_mod.live_mask(cap, t.row_counts[0])
+            perm, cnt = compact_mod.compact_indices(keep)
+            cols = tuple(c.take(perm, valid_mask=compact_mod.live_mask(cap, cnt))
+                         for c in t.columns)
+            return Table(cols, jnp.reshape(cnt, (1,)), names, ctx)
+
+        return _shard_wise(self.ctx, fn, self, mask, key=("filter",))
+
+    # comparison dunders return boolean Tables (pycylon table.pyx:1170-1374)
+    def __eq__(self, other):  # type: ignore[override]
+        from . import compute as compute_mod
+
+        return compute_mod.compare(self, other, "eq")
+
+    def __ne__(self, other):  # type: ignore[override]
+        from . import compute as compute_mod
+
+        return compute_mod.compare(self, other, "ne")
+
+    def __lt__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.compare(self, other, "lt")
+
+    def __gt__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.compare(self, other, "gt")
+
+    def __le__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.compare(self, other, "le")
+
+    def __ge__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.compare(self, other, "ge")
+
+    __hash__ = object.__hash__
+
+    def __or__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.logical_op(self, other, "or")
+
+    def __and__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.logical_op(self, other, "and")
+
+    def __invert__(self):
+        from . import compute as compute_mod
+
+        return compute_mod.invert(self)
+
+    def __neg__(self):
+        from . import compute as compute_mod
+
+        return compute_mod.neg(self)
+
+    def __add__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.add(self, other)
+
+    def __sub__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.subtract(self, other)
+
+    def __mul__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.multiply(self, other)
+
+    def __truediv__(self, other):
+        from . import compute as compute_mod
+
+        return compute_mod.divide(self, other)
+
+    def fillna(self, fill_value) -> "Table":
+        from . import compute as compute_mod
+
+        return compute_mod.fillna(self, fill_value)
+
+    def where(self, condition, other=None) -> "Table":
+        from . import compute as compute_mod
+
+        return compute_mod.where(self, condition, other)
+
+    def isnull(self) -> "Table":
+        from . import compute as compute_mod
+
+        return compute_mod.is_null(self)
+
+    isna = isnull
+
+    def notnull(self) -> "Table":
+        from . import compute as compute_mod
+
+        return compute_mod.invert(compute_mod.is_null(self))
+
+    notna = notnull
+
+    def dropna(self, axis: int = 0, how: str = "any") -> "Table":
+        from . import compute as compute_mod
+
+        return compute_mod.drop_na(self, how=how, axis=axis)
+
+    def isin(self, values, skip_null: bool = True) -> "Table":
+        from . import compute as compute_mod
+
+        return compute_mod.is_in(self, values, skip_null)
+
+    def drop(self, column_names) -> "Table":
+        """Drop columns (reference: table.pyx:1625-1652)."""
+        if isinstance(column_names, (str, int, np.integer)):
+            column_names = [column_names]
+        drop_idx = set(self._resolve_many(column_names))
+        keep = [i for i in range(len(self.columns)) if i not in drop_idx]
+        return self.project(keep)
+
+    def applymap(self, fn) -> "Table":
+        """Apply a vectorized function to every column's values
+        (reference: python/test/test_udf applymap coverage)."""
+        cols = []
+        for c in self.columns:
+            if c.is_string:
+                raise CylonError(Code.Invalid, "applymap on string column")
+            data = fn(c.data)
+            cols.append(Column(jnp.where(c.validity, data,
+                                         jnp.zeros((), data.dtype)),
+                               c.validity, None,
+                               dtypes.from_numpy_dtype(data.dtype)))
+        return Table(tuple(cols), self.row_counts, self.names, self.ctx)
+
     # -- partitioning / shuffle ----------------------------------------
     def shuffle(self, refs) -> "Table":
         """Hash-repartition rows over the mesh (reference: Shuffle,

@@ -1,0 +1,116 @@
+"""DataFrame/Series/Index facade tests.
+
+Mirrors python/test/test_frame.py + test_series/test_index coverage of the
+reference (python/pycylon/frame.py, series.py, index.py).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import DataFrame, RangeIndex, Series, Table
+
+
+def test_ctor_from_dict(local_ctx):
+    df = DataFrame({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0]})
+    assert df.shape == (3, 2)
+    assert df.columns == ["a", "b"]
+    assert not df.is_distributed
+
+
+def test_ctor_from_list_of_columns():
+    df = DataFrame([[1, 2, 3], [4, 5, 6]])
+    assert df.columns == ["0", "1"]
+    assert df.to_dict() == {"0": [1, 2, 3], "1": [4, 5, 6]}
+
+
+def test_ctor_from_pandas_and_numpy(rng):
+    pdf = pd.DataFrame({"x": rng.random(10), "y": rng.integers(0, 5, 10)})
+    df = DataFrame(pdf)
+    pd.testing.assert_frame_equal(df.to_pandas(), pdf)
+
+    arr = rng.random((6, 3))
+    df2 = DataFrame(arr, columns=["a", "b", "c"])
+    assert df2.columns == ["a", "b", "c"]
+    assert np.allclose(df2.to_numpy(), arr)
+
+
+def test_getitem_setitem_filter():
+    df = DataFrame({"a": [1, 2, 3, 4], "b": [10, 20, 30, 40]})
+    assert df["a"].to_dict() == {"a": [1, 2, 3, 4]}
+    assert df[["b", "a"]].columns == ["b", "a"]
+    got = df[df["a"] > 2]
+    assert got.to_dict() == {"a": [3, 4], "b": [30, 40]}
+    df["c"] = 5
+    assert df.to_dict()["c"] == [5] * 4
+    df["a"] = np.array([9, 9, 9, 9])
+    assert df.to_dict()["a"] == [9] * 4
+
+
+def test_dunders_math():
+    df = DataFrame({"a": [1, 2, 3]})
+    assert (df + 1).to_dict()["a"] == [2, 3, 4]
+    assert (df * 3).to_dict()["a"] == [3, 6, 9]
+    assert (-df).to_dict()["a"] == [-1, -2, -3]
+    m = (df >= 2) & (df <= 2)
+    assert m.to_dict()["a"] == [False, True, False]
+
+
+def test_cleaning():
+    df = DataFrame(pd.DataFrame({"x": [1.0, np.nan, 3.0], "y": [4.0, 5.0, 6.0]}))
+    assert df.isnull().to_dict()["x"] == [False, True, False]
+    assert df.fillna(0.0).to_dict()["x"] == [1.0, 0.0, 3.0]
+    assert df.dropna().to_dict()["x"] == [1.0, 3.0]
+    assert df.drop("x").columns == ["y"]
+    assert df.rename({"x": "z"}).columns == ["z", "y"]
+    assert df.add_prefix("p_").columns == ["p_x", "p_y"]
+    assert df.add_suffix("_s").columns == ["x_s", "y_s"]
+
+
+def test_merge_groupby_sort(rng):
+    left = DataFrame({"k": [1, 2, 3, 4], "a": [1.0, 2.0, 3.0, 4.0]})
+    right = DataFrame({"k": [2, 3, 4, 5], "b": [20.0, 30.0, 40.0, 50.0]})
+    j = left.merge(right, on="k")
+    assert sorted(j.to_dict()["l_k"]) == [2, 3, 4]
+    g = DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 10.0]}).groupby(
+        "k", {"v": "sum"})
+    d = dict(zip(g.to_dict()["k"], g.to_dict()["sum_v"]))
+    assert d == {1: 3.0, 2: 10.0}
+    s = DataFrame({"a": [3, 1, 2]}).sort_values("a")
+    assert s.to_dict()["a"] == [1, 2, 3]
+    u = DataFrame({"a": [1, 1, 2]}).drop_duplicates()
+    assert sorted(u.to_dict()["a"]) == [1, 2]
+
+
+def test_series_and_index():
+    df = DataFrame({"a": [1, 2, 3]})
+    s = df.a
+    assert isinstance(s, Series)
+    assert s.shape == (3,)
+    assert list(s.to_numpy()) == [1, 2, 3]
+    assert s[1] == 2
+    assert isinstance(df.index, RangeIndex)
+    assert len(df.index) == 3
+
+    s2 = Series("v", data=[1.5, 2.5])
+    assert s2.id == "v"
+    assert list(s2.to_numpy()) == [1.5, 2.5]
+
+
+def test_where():
+    df = DataFrame({"a": [1, 2, 3, 4]})
+    w = df.where(df > 2)
+    assert w.to_dict()["a"] == [None, None, 3, 4]
+    w2 = df.where(df > 2, 0)
+    assert w2.to_dict()["a"] == [0, 0, 3, 4]
+
+
+def test_distributed_frame(ctx4, rng):
+    pdf = pd.DataFrame({"k": rng.integers(0, 10, 64), "v": rng.random(64)})
+    df = DataFrame(pdf, ctx=ctx4, distributed=True)
+    assert df.is_distributed
+    g = df.groupby("k", {"v": "sum"})
+    exp = pdf.groupby("k").agg(sum_v=("v", "sum")).reset_index()
+    got = g.to_pandas().sort_values("k").reset_index(drop=True)
+    assert np.allclose(got["sum_v"], exp["sum_v"])
+    srt = df.sort_values("k")
+    assert (np.diff(srt.to_pandas()["k"].to_numpy()) >= 0).all()
