@@ -160,6 +160,38 @@ def from_numpy(values: np.ndarray, *, validity: Optional[np.ndarray] = None,
     return Column(jnp.asarray(buf), jnp.asarray(valid), None, dt)
 
 
+def from_native_buffers(data: np.ndarray, validity: Optional[np.ndarray],
+                        lengths: Optional[np.ndarray] = None, *,
+                        capacity: Optional[int] = None,
+                        string_width: Optional[int] = None) -> Column:
+    """Build a Column from the native (C++) layer's Column-shaped buffers —
+    1-D fixed-width data, or 2-D uint8 byte matrix + lengths for strings
+    (cylon_tpu/native csv_read / registry_get output).  The buffers already
+    match the device layout, so this is pad-to-capacity + device_put only."""
+    n = len(data)
+    cap = _next_capacity(n, capacity)
+    if data.ndim == 2:  # string byte matrix
+        w = data.shape[1]
+        if string_width and string_width > w:
+            w = string_width
+        mat = np.zeros((cap, w), np.uint8)
+        mat[:n, : data.shape[1]] = data
+        lens = np.zeros((cap,), np.int32)
+        if lengths is not None:
+            lens[:n] = np.minimum(lengths, w)
+        valid = np.zeros((cap,), bool)
+        valid[:n] = True if validity is None else validity[:n]
+        return Column(jnp.asarray(mat), jnp.asarray(valid), jnp.asarray(lens),
+                      dtypes.string)
+    dt = dtypes.from_numpy_dtype(data.dtype)
+    buf = np.zeros((cap,), data.dtype)
+    buf[:n] = data
+    valid = np.zeros((cap,), bool)
+    valid[:n] = True if validity is None else validity[:n]
+    buf[:n] = np.where(valid[:n], buf[:n], np.zeros((), data.dtype))
+    return Column(jnp.asarray(buf), jnp.asarray(valid), None, dt)
+
+
 def from_arrow(arr, *, capacity: Optional[int] = None,
                string_width: int = DEFAULT_STRING_WIDTH) -> Column:
     """Build a Column from a pyarrow Array/ChunkedArray (the ingest bridge the

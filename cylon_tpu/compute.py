@@ -291,7 +291,9 @@ def where(table, condition, other: Optional[Scalar] = None):
         else:
             if c.is_string:
                 raise CylonError(Code.Invalid, "where(other=) on string column")
-            validity = c.validity
+            # mask-False rows take `other` unconditionally, including rows
+            # that were null (reference: table.pyx where(); pandas semantics)
+            validity = c.validity | ~keep
             data = jnp.where(keep, c.data, jnp.asarray(other, c.data.dtype))
         cols.append(_result_col(data, validity, c.dtype) if not c.is_string
                     else Column(jnp.where(validity[:, None], c.data, 0),
@@ -337,7 +339,8 @@ def drop_na(table, how: str = "any", axis: int = 0):
             keep = [i for n, i in counts if n == 0]
         elif how == "all":
             live_total = table.row_count
-            keep = [i for n, i in counts if n < live_total]
+            # a zero-row table has no all-null column (pandas keeps all)
+            keep = [i for n, i in counts if live_total == 0 or n < live_total]
         else:
             raise CylonError(Code.Invalid, f"bad how={how!r}")
         return table.project(keep)

@@ -67,7 +67,7 @@ class DataFrame:
             arrays = {str(k): np.asarray(v) for k, v in data.items()}
             if columns is not None:
                 arrays = {str(c): arrays[str(c)] for c in columns}
-            return Table.from_pydict(arrays, ctx=ctx) if arrays else _empty_table(ctx)
+            return Table.from_pydict(arrays, ctx=ctx)
         if isinstance(data, (list, tuple)):
             # each inner sequence is one column (reference frame.py:77-86)
             names = ([str(i) for i in range(len(data))] if columns is None

@@ -97,7 +97,7 @@ class RangeIndex(Index):
         return np.arange(self._start, self._stop, self._step)
 
     def __len__(self) -> int:
-        return max(0, (self._stop - self._start + self._step - 1) // self._step)
+        return len(range(self._start, self._stop, self._step))
 
 
 class CategoricalIndex(Index):

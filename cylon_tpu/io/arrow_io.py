@@ -28,12 +28,64 @@ from .csv_config import CSVReadOptions, CSVWriteOptions, ParquetOptions
 PathLike = Union[str, "os.PathLike[str]"]
 
 
+# pyarrow ConvertOptions default null sentinels, passed to the native
+# parser so both paths agree on null semantics
+_DEFAULT_NULLS = ["", "#N/A", "#N/A N/A", "#NA", "-1.#IND", "-1.#QNAN",
+                  "-NaN", "-nan", "1.#IND", "1.#QNAN", "N/A", "NA", "NULL",
+                  "NaN", "n/a", "nan", "null"]
+
+
 def _read_csv_arrow(path: PathLike, options: CSVReadOptions):
     import pyarrow.csv as pc
 
     read, parse, convert = options.to_pyarrow()
     return pc.read_csv(str(path), read_options=read, parse_options=parse,
                        convert_options=convert)
+
+
+def _native_csv_compatible(options: CSVReadOptions) -> bool:
+    """The native parser handles the common-case option envelope; anything
+    else falls back to the pyarrow reader (same outputs either way)."""
+    import os
+
+    if os.environ.get("CYLON_TPU_NO_NATIVE_IO"):
+        return False
+    from .. import native
+
+    return (not options.column_types
+            and options.include_columns is None
+            and options.true_values is None
+            and options.false_values is None
+            and not options.use_escaping
+            and options.double_quote
+            and len(options.delimiter) == 1
+            and native.available())
+
+
+def _read_csv_native(path: PathLike, options: CSVReadOptions):
+    """Read over the native (C++) threaded parser into Column-shaped
+    buffers (cylon_tpu/native/src/csv.cpp)."""
+    from .. import native
+
+    has_header = not (options.autogenerate_column_names
+                      or options.column_names is not None)
+    names, cols = native.csv_read(
+        str(path), delimiter=options.delimiter, has_header=has_header,
+        skip_rows=options.skip_rows,
+        string_width=options.string_width or 0,
+        null_values=(options.null_values if options.null_values is not None
+                     else _DEFAULT_NULLS),
+        use_quoting=options.use_quoting, quote_char=options.quote_char,
+        strings_can_be_null=options.strings_can_be_null)
+    if options.column_names is not None:
+        if len(options.column_names) != len(names):
+            from ..status import Code, CylonError
+
+            raise CylonError(Code.Invalid,
+                             f"{len(options.column_names)} column names for "
+                             f"{len(names)} columns")
+        names = list(options.column_names)
+    return names, cols
 
 
 def _read_parquet_arrow(path: PathLike):
@@ -62,6 +114,19 @@ def read_csv(paths: Union[PathLike, Sequence[PathLike]],
 
     options = options or CSVReadOptions()
     ctx = ctx or default_context()
+    if _native_csv_compatible(options):
+        from ..table import _table_from_native_tables
+
+        reader = lambda p: _read_csv_native(p, options)  # noqa: E731
+        if isinstance(paths, (list, tuple)):
+            ntables = _read_many(paths, reader,
+                                 options.concurrent_file_reads)
+            return _table_from_native_tables(
+                ntables, ctx, capacity, per_shard=True,
+                string_width=options.string_width)
+        return _table_from_native_tables(
+            [reader(paths)], ctx, capacity, per_shard=False,
+            string_width=options.string_width)
     if isinstance(paths, (list, tuple)):
         atables = _read_many(paths, lambda p: _read_csv_arrow(p, options),
                              options.concurrent_file_reads)
@@ -96,13 +161,41 @@ def read_parquet(paths: Union[PathLike, Sequence[PathLike]],
 
 def write_csv(table, path: PathLike,
               options: Optional[CSVWriteOptions] = None) -> None:
-    """Gathered CSV write (reference: Table::WriteCSV, table.cpp:243-256)."""
+    """Gathered CSV write (reference: Table::WriteCSV, table.cpp:243-256).
+
+    Uses the native (C++) writer when available; pandas fallback."""
+    import os
+
     options = options or CSVWriteOptions()
-    df = table.to_pandas()
+    names = list(table.column_names)
     if options.column_names is not None:
-        if len(options.column_names) != len(df.columns):
+        if len(options.column_names) != len(names):
             raise CylonError(Code.Invalid, "column_names length mismatch")
-        df.columns = options.column_names
+        names = list(options.column_names)
+    from .. import dtypes, native
+
+    # temporal columns need logical formatting (datetime strings, not raw
+    # int64 micros) — only the pandas path renders those
+    temporal = any(c.dtype.type in (dtypes.Type.TIMESTAMP, dtypes.Type.DATE32,
+                                    dtypes.Type.DATE64, dtypes.Type.TIME32,
+                                    dtypes.Type.TIME64)
+                   for c in table.columns)
+    if (native.available() and not temporal
+            and not os.environ.get("CYLON_TPU_NO_NATIVE_IO")):
+        import numpy as np
+
+        cols, total = table._gathered_columns()
+        arrays, validities, lengths_list = [], [], []
+        for c in cols:
+            arrays.append(np.asarray(c.data[:total]))
+            validities.append(np.asarray(c.validity[:total]))
+            lengths_list.append(
+                None if c.lengths is None else np.asarray(c.lengths[:total]))
+        native.csv_write(str(path), names, arrays, validities, lengths_list,
+                         delimiter=options.delimiter)
+        return
+    df = table.to_pandas()
+    df.columns = names
     df.to_csv(str(path), sep=options.delimiter, index=False)
 
 

@@ -1,0 +1,52 @@
+"""Build libcylon_tpu.so from the C++ sources in ``src/``.
+
+The native layer is compiled on first import (and cached next to the
+sources), the same role as the reference's CMake build of libcylon
+(cpp/CMakeLists.txt) — here a single g++ invocation because the library has
+no external dependencies.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+from pathlib import Path
+
+_SRC_DIR = Path(__file__).parent / "src"
+_LIB_NAME = "libcylon_tpu.so"
+
+
+def lib_path() -> Path:
+    return Path(__file__).parent / _LIB_NAME
+
+
+def _sources():
+    return sorted(_SRC_DIR.glob("*.cpp"))
+
+
+def needs_build(lib: Path) -> bool:
+    if not lib.exists():
+        return True
+    mtime = lib.stat().st_mtime
+    deps = list(_sources()) + list(_SRC_DIR.glob("*.hpp"))
+    return any(s.stat().st_mtime > mtime for s in deps)
+
+
+def build(verbose: bool = False) -> Path:
+    lib = lib_path()
+    if not needs_build(lib):
+        return lib
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           "-o", str(lib)] + [str(s) for s in _sources()]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
+    if verbose:
+        print(f"built {lib}")
+    return lib
+
+
+if __name__ == "__main__":
+    build(verbose=True)

@@ -989,6 +989,105 @@ def _table_from_arrow_tables(atables, ctx: CylonContext,
     return Table(tuple(cols), _sharded_counts(counts, ctx), names, ctx)
 
 
+def _table_from_native_tables(ntables, ctx: CylonContext,
+                              capacity: Optional[int], *, per_shard: bool,
+                              string_width: Optional[int] = None) -> Table:
+    """Build a Table from the native CSV reader's (names, cols) outputs —
+    the native-ingest mirror of ``_table_from_arrow_tables``.  Each element
+    of ``ntables`` is ``(names, cols)`` with cols holding ``data`` /
+    ``validity`` / optional ``lengths`` numpy buffers (cylon_tpu/native)."""
+    if not ntables:
+        raise CylonError(Code.Invalid, "no input files")
+    names = tuple(ntables[0][0])
+    ncols = len(names)
+    for i, (nm, _) in enumerate(ntables[1:], 1):
+        if tuple(nm) != names:
+            raise CylonError(Code.Invalid,
+                             f"schema mismatch across files: {nm} vs "
+                             f"{list(names)}")
+    # unify numeric dtypes across files (int64 in one, float64 in another)
+    for c in range(ncols):
+        kinds = {nt[1][c]["data"].dtype.kind if nt[1][c]["data"].ndim == 1
+                 else "S" for nt in ntables}
+        if "S" in kinds and kinds != {"S"}:
+            raise CylonError(Code.Invalid,
+                             f"column {names[c]} is string in some files, "
+                             "numeric in others")
+        if "f" in kinds and "i" in kinds:
+            for nt in ntables:
+                nt[1][c]["data"] = nt[1][c]["data"].astype(np.float64)
+    world = ctx.GetWorldSize()
+    if not per_shard or world == 1:
+        if len(ntables) == 1:
+            nm, cols = ntables[0]
+        else:
+            nm = names
+            cols = []
+            for c in range(ncols):
+                parts = [nt[1][c] for nt in ntables]
+                merged: Dict[str, np.ndarray] = {}
+                if parts[0]["data"].ndim == 2:
+                    w = max(p["data"].shape[1] for p in parts)
+                    mats = []
+                    for p in parts:
+                        m = p["data"]
+                        if m.shape[1] < w:
+                            m = np.pad(m, ((0, 0), (0, w - m.shape[1])))
+                        mats.append(m)
+                    merged["data"] = np.concatenate(mats)
+                    merged["lengths"] = np.concatenate(
+                        [p["lengths"] for p in parts])
+                else:
+                    merged["data"] = np.concatenate([p["data"] for p in parts])
+                merged["validity"] = np.concatenate(
+                    [p["validity"] for p in parts])
+                cols.append(merged)
+        n = len(cols[0]["data"]) if cols else 0
+        if world == 1:
+            cap = capacity or max(8, n)
+            built = tuple(
+                column_mod.from_native_buffers(
+                    c["data"], c.get("validity"), c.get("lengths"),
+                    capacity=cap, string_width=string_width)
+                for c in cols)
+            return Table(built, jnp.asarray([n], jnp.int32), names, ctx)
+        chunk, counts, shard_cap = _shard_plan(n, world, capacity)
+        out_cols = []
+        for c in cols:
+            shard_cols = [
+                column_mod.from_native_buffers(
+                    c["data"][s * chunk: s * chunk + counts[s]],
+                    c["validity"][s * chunk: s * chunk + counts[s]],
+                    None if "lengths" not in c
+                    else c["lengths"][s * chunk: s * chunk + counts[s]],
+                    capacity=shard_cap, string_width=string_width)
+                for s in range(world)]
+            out_cols.append(_assemble_sharded(shard_cols, ctx))
+        return Table(tuple(out_cols), _sharded_counts(counts, ctx), names, ctx)
+    if len(ntables) != world:
+        raise CylonError(Code.Invalid,
+                         f"{len(ntables)} files for a {world}-shard mesh; "
+                         "per-shard reads need one file per mesh position")
+    counts = [len(nt[1][0]["data"]) if nt[1] else 0 for nt in ntables]
+    shard_cap = capacity // world if capacity else max(8, max(counts))
+    if shard_cap < max(counts):
+        big = counts.index(max(counts))
+        raise CylonError(
+            Code.Invalid,
+            f"capacity {capacity} gives {shard_cap} rows per shard but file "
+            f"{big} has {counts[big]} rows")
+    out_cols = []
+    for c in range(ncols):
+        shard_cols = [
+            column_mod.from_native_buffers(
+                nt[1][c]["data"], nt[1][c].get("validity"),
+                nt[1][c].get("lengths"), capacity=shard_cap,
+                string_width=string_width)
+            for nt in ntables]
+        out_cols.append(_assemble_sharded(shard_cols, ctx))
+    return Table(tuple(out_cols), _sharded_counts(counts, ctx), names, ctx)
+
+
 def _distribute_numpy(arrays: Dict[str, np.ndarray], names, n: int,
                       ctx: CylonContext, capacity: Optional[int]) -> Table:
     """Split rows into contiguous per-shard chunks and lay them out as one

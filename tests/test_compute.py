@@ -128,6 +128,20 @@ def test_where(t):
     assert w2["a"] == [0, 0, 3, 4, 5]
 
 
+def test_where_other_replaces_nulls(local_ctx):
+    # null rows whose condition is False take `other` (pandas / reference
+    # table.pyx where() semantics)
+    t = Table.from_pandas(pd.DataFrame({"x": [1.0, np.nan]}), ctx=local_ctx)
+    cond = t.notnull() & (t > 100)
+    assert t.where(cond, 5.0).to_pydict() == {"x": [5.0, 5.0]}
+
+
+def test_dropna_cols_empty_table(local_ctx):
+    t = Table.from_pandas(pd.DataFrame({"x": [1.0], "y": [2.0]}).head(0),
+                          ctx=local_ctx)
+    assert t.dropna(axis=1, how="all").column_names == ["x", "y"]
+
+
 def test_drop(t):
     assert t.drop("a").column_names == ["b"]
     assert t.drop(["b"]).column_names == ["a"]

@@ -96,6 +96,12 @@ def test_series_and_index():
     assert list(s2.to_numpy()) == [1.5, 2.5]
 
 
+def test_range_index_negative_step():
+    idx = RangeIndex(range(5, 0, -1))
+    assert len(idx) == 5
+    assert len(idx) == len(idx.index_values)
+
+
 def test_where():
     df = DataFrame({"a": [1, 2, 3, 4]})
     w = df.where(df > 2)

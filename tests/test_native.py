@@ -1,0 +1,209 @@
+"""Native (C++) runtime layer: murmur3, row hashing, CSV, pool, registry.
+
+Mirrors the reference's native-component coverage (util/murmur3, the CSV IO
+layer exercised by cpp/test/create_table_test.cpp, and the
+arrow_builder/table_api surface driven by the Java binding tests).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from cylon_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native layer unavailable: {native.load_error()}")
+
+
+# -- murmur3 / hashing ----------------------------------------------------
+
+def test_murmur3_known_vectors():
+    # public MurmurHash3_x86_32 test vectors
+    assert native.murmur3_32(b"", 0) == 0
+    assert native.murmur3_32(b"hello", 0) == 0x248BFA47
+    assert native.murmur3_32(b"hello, world", 0) == 0x149BBB7F
+    assert native.murmur3_32(b"", 1) == 0x514E28B7
+
+
+def test_row_hash_matches_single_column_murmur():
+    k = np.array([0, 1, 2, 1 << 40], dtype=np.int64)
+    h = native.row_hash([k])
+    for i, v in enumerate(k):
+        expect = (31 * 1 + native.murmur3_32(
+            v.tobytes(), 0)) & 0xFFFFFFFF
+        assert h[i] == expect
+
+
+def test_row_hash_multi_column_combiner():
+    a = np.array([7, 7], dtype=np.int64)
+    b = np.array([1, 2], dtype=np.float64)
+    h = native.row_hash([a, b])
+    assert h[0] != h[1]  # second column distinguishes
+    # same combiner as the device path: 31*h + murmur(value)
+    h0 = 31 * 1 + native.murmur3_32(a[0].tobytes(), 0)
+    h0 = (31 * h0 + native.murmur3_32(b[0].tobytes(), 0)) & 0xFFFFFFFF
+    assert h[0] == h0 & 0xFFFFFFFF
+
+
+def test_row_hash_string_column():
+    mat = np.zeros((3, 8), np.uint8)
+    for i, s in enumerate([b"ab", b"abc", b"ab"]):
+        mat[i, : len(s)] = np.frombuffer(s, np.uint8)
+    lens = np.array([2, 3, 2], np.int32)
+    h = native.row_hash([mat], [lens])
+    assert h[0] == h[2] and h[0] != h[1]
+    assert h[0] == (31 + native.murmur3_32(b"ab", 0)) & 0xFFFFFFFF
+
+
+def test_partition_targets_histogram():
+    rng = np.random.default_rng(0)
+    h = rng.integers(0, 1 << 32, 10_000, dtype=np.uint32)
+    for world in (3, 4):  # modulo and power-of-two mask paths
+        t, hist = native.partition_targets(h, world)
+        assert hist.sum() == len(h)
+        assert (t < world).all()
+        np.testing.assert_array_equal(np.bincount(t, minlength=world), hist)
+        np.testing.assert_array_equal(t, h % world)
+
+
+# -- CSV ------------------------------------------------------------------
+
+def test_csv_inference_and_nulls(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text('i,f,b,s\n1,1.5,true,x\n2,NA,false,"a,b"\nNA,3.5,true,NA\n')
+    names, cols = native.csv_read(str(p), strings_can_be_null=True)
+    assert names == ["i", "f", "b", "s"]
+    i, f, b, s = cols
+    assert i["data"].dtype == np.int64
+    np.testing.assert_array_equal(i["validity"], [True, True, False])
+    assert f["data"].dtype == np.float64
+    np.testing.assert_array_equal(f["validity"], [True, False, True])
+    assert b["data"].dtype == bool
+    np.testing.assert_array_equal(b["data"], [True, False, True])
+    got = [bytes(r[:n]) for r, n in zip(s["data"], s["lengths"])]
+    assert got[:2] == [b"x", b"a,b"]
+    np.testing.assert_array_equal(s["validity"], [True, True, False])
+
+
+def test_csv_strings_not_null_by_default(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("s\nx\nNA\n")
+    _, cols = native.csv_read(str(p))
+    assert cols[0]["validity"].all()  # "NA" stays a string
+
+
+def test_csv_matches_pyarrow_path(tmp_path):
+    """Golden check: native ingest == pyarrow ingest at the Table level."""
+    import pandas as pd
+
+    from cylon_tpu import Table
+    from cylon_tpu.context import CylonContext
+
+    ctx = CylonContext.Init()
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({
+        "a": rng.integers(-100, 100, 200),
+        "b": rng.random(200),
+        "c": [f"s{i % 13}" for i in range(200)],
+    })
+    p = tmp_path / "t.csv"
+    df.to_csv(p, index=False)
+    t_native = Table.from_csv(p, ctx=ctx)
+    os.environ["CYLON_TPU_NO_NATIVE_IO"] = "1"
+    try:
+        t_arrow = Table.from_csv(p, ctx=ctx)
+    finally:
+        del os.environ["CYLON_TPU_NO_NATIVE_IO"]
+    pd.testing.assert_frame_equal(t_native.to_pandas(), t_arrow.to_pandas())
+
+
+def test_csv_write_roundtrip(tmp_path):
+    import pandas as pd
+
+    from cylon_tpu import Table
+    from cylon_tpu.context import CylonContext
+
+    ctx = CylonContext.Init()
+    df = pd.DataFrame({
+        "x": np.array([1, 2, 3], np.int64),
+        "y": [0.1, 0.2, 0.30000000000000004],
+        "s": ["plain", 'quo"te', "com,ma"],
+    })
+    t = Table.from_pandas(df, ctx=ctx)
+    out = tmp_path / "o.csv"
+    t.to_csv(out)
+    pd.testing.assert_frame_equal(pd.read_csv(out), df)
+
+
+def test_csv_no_header_and_skip_rows(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("# banner\n1,2\n3,4\n")
+    names, cols = native.csv_read(str(p), has_header=False, skip_rows=1)
+    assert names == ["f0", "f1"]
+    np.testing.assert_array_equal(cols[0]["data"], [1, 3])
+    np.testing.assert_array_equal(cols[1]["data"], [2, 4])
+
+
+# -- memory pool ----------------------------------------------------------
+
+def test_memory_pool_accounting():
+    pool = native.MemoryPool()
+    p1 = pool.allocate(1000)
+    p2 = pool.allocate(24)
+    assert pool.bytes_allocated == 1024
+    assert pool.max_memory == 1024
+    assert pool.num_allocations == 2
+    pool.free(p1)
+    assert pool.bytes_allocated == 24
+    assert pool.max_memory == 1024
+    pool.free(p2)
+    assert pool.bytes_allocated == 0
+    pool.close()
+
+
+# -- builder + registry (foreign-binding surface) -------------------------
+
+def test_builder_registry_roundtrip():
+    native.builder_begin("reg_t1")
+    native.builder_add_column("reg_t1", "k", np.arange(10, dtype=np.int64))
+    native.builder_add_column("reg_t1", "v", np.linspace(0, 1, 10),
+                              validity=np.arange(10) % 2 == 0)
+    native.builder_finish("reg_t1")
+    try:
+        assert native.registry_contains("reg_t1")
+        assert "reg_t1" in native.registry_ids()
+        names, cols = native.registry_get("reg_t1")
+        assert names == ["k", "v"]
+        np.testing.assert_array_equal(cols[0]["data"], np.arange(10))
+        np.testing.assert_array_equal(cols[1]["validity"],
+                                      np.arange(10) % 2 == 0)
+    finally:
+        assert native.registry_remove("reg_t1")
+    assert not native.registry_contains("reg_t1")
+
+
+def test_builder_row_count_mismatch_rejected():
+    native.builder_begin("reg_bad")
+    native.builder_add_column("reg_bad", "a", np.arange(5))
+    with pytest.raises(RuntimeError):
+        native.builder_add_column("reg_bad", "b", np.arange(6))
+    native.builder_finish("reg_bad")
+    native.registry_remove("reg_bad")
+
+
+def test_registry_string_column():
+    mat = np.zeros((2, 8), np.uint8)
+    mat[0, :2] = np.frombuffer(b"hi", np.uint8)
+    mat[1, :3] = np.frombuffer(b"bye", np.uint8)
+    native.builder_begin("reg_s")
+    native.builder_add_column("reg_s", "s", mat,
+                              lengths=np.array([2, 3], np.int32))
+    native.builder_finish("reg_s")
+    try:
+        _, cols = native.registry_get("reg_s")
+        got = [bytes(r[:n]) for r, n in zip(cols[0]["data"],
+                                            cols[0]["lengths"])]
+        assert got == [b"hi", b"bye"]
+    finally:
+        native.registry_remove("reg_s")
