@@ -1,0 +1,97 @@
+"""Golden-file verification against the reference's committed outputs.
+
+The reference's C++ test suite reads rank-sharded inputs
+``data/input/csv{1,2}_<rank>.csv`` and compares each distributed op's output
+against committed goldens ``data/output/<op>_<world>_<rank>.csv`` via
+multiset subtract (reference: cpp/test/test_utils.hpp:29-51,
+cpp/test/join_test.cpp:20-30, cpp/test/CMakeLists.txt:56-99 — world sizes
+1/2/4).  Partition *placement* differs between the reference's murmur3/modulo
+hash and ours, so per-rank contents are not comparable — but the global
+multiset (all ranks concatenated) is partition-invariant and must match
+exactly.  The per-rank row-count assertions of
+python/test/test_dist_rl.py:77-100 are likewise checked as global totals.
+"""
+import os
+
+import pandas as pd
+import pytest
+
+REF_DATA = "/root/reference/data"
+TUTORIAL = "/root/reference/cpp/src/tutorial/data"
+
+needs_ref = pytest.mark.skipif(
+    not os.path.isdir(REF_DATA), reason="reference data not mounted")
+
+
+def _inputs(world):
+    left = [f"{REF_DATA}/input/csv1_{r}.csv" for r in range(world)]
+    right = [f"{REF_DATA}/input/csv2_{r}.csv" for r in range(world)]
+    return left, right
+
+
+def _golden(op, world):
+    frames = []
+    for r in range(world):
+        path = f"{REF_DATA}/output/{op}_{world}_{r}.csv"
+        df = pd.read_csv(path, header=0)
+        df.columns = [f"c{i}" for i in range(df.shape[1])]
+        frames.append(df)
+    return pd.concat(frames, ignore_index=True)
+
+
+def _tables(world, request):
+    from cylon_tpu import Table
+
+    ctx = request.getfixturevalue(
+        {1: "local_ctx", 2: "ctx2", 4: "ctx4"}[world])
+    lp, rp = _inputs(world)
+    left = Table.from_csv(lp if world > 1 else lp[0], ctx=ctx)
+    right = Table.from_csv(rp if world > 1 else rp[0], ctx=ctx)
+    return left, right
+
+
+@needs_ref
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_join_inner_golden(world, request):
+    from tests.utils import assert_rows_equal
+
+    left, right = _tables(world, request)
+    out = (left.join(right, on=0, how="inner") if world == 1
+           else left.distributed_join(right, on=0, how="inner"))
+    assert out.column_count == 4
+    assert_rows_equal(out, _golden("join_inner", world), ndigits=6)
+
+
+@needs_ref
+@pytest.mark.parametrize("op", ["union", "subtract", "intersect"])
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_set_op_golden(op, world, request):
+    from tests.utils import assert_rows_equal
+
+    left, right = _tables(world, request)
+    if world == 1:
+        out = getattr(left, op)(right)
+    else:
+        out = getattr(left, f"distributed_{op}")(right)
+    assert out.column_count == 2
+    assert_rows_equal(out, _golden(op, world), ndigits=6)
+
+
+@needs_ref
+def test_user_usage_counts(request):
+    """Global totals of python/test/test_dist_rl.py:77-100 (per-rank counts
+    1424/1648/2704/1552 join, 62/53/53/72 union+intersect, 0 subtract)."""
+    from cylon_tpu import Table
+
+    ctx = request.getfixturevalue("ctx4")
+    paths = [f"{TUTORIAL}/user_usage_tm_{r + 1}.csv" for r in range(4)]
+    tb1 = Table.from_csv(paths, ctx=ctx)
+    tb2 = Table.from_csv(paths, ctx=ctx)
+
+    joined = tb1.distributed_join(tb2, on=0, how="inner", algorithm="hash")
+    assert joined.column_count == 8
+    assert joined.row_count == 1424 + 1648 + 2704 + 1552
+
+    assert tb1.distributed_union(tb2).row_count == 62 + 53 + 53 + 72
+    assert tb1.distributed_intersect(tb2).row_count == 62 + 53 + 53 + 72
+    assert tb1.distributed_subtract(tb2).row_count == 0
