@@ -299,7 +299,9 @@ def where(table, condition, other: Optional[Scalar] = None):
                     else Column(jnp.where(validity[:, None], c.data, 0),
                                 validity, jnp.where(validity, c.lengths, 0),
                                 c.dtype))
-    return _table(cols, table.row_counts, table.names, table.ctx)
+    # where(other=) marks mask-False rows valid — re-invalidate padding rows
+    # so kernels that trust validity never see phantom `other` values
+    return _mask_padding(_table(cols, table.row_counts, table.names, table.ctx))
 
 
 def is_in(table, values: Sequence, skip_null: bool = True):

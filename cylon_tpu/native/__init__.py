@@ -161,10 +161,22 @@ def load_error() -> Optional[str]:
     return _load_error
 
 
-def murmur3_32(data: bytes, seed: int = 0) -> int:
+def _require() -> ct.CDLL:
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native unavailable: {_load_error}")
+    return lib
+
+
+def _read_buf(ptr, ctype, shape, np_dtype) -> np.ndarray:
+    """Copy a C buffer into numpy; empty tables have no buffer to read."""
+    if shape[0] == 0 or not ptr:
+        return np.zeros(shape, dtype=np_dtype)
+    return np.ctypeslib.as_array(ct.cast(ptr, ct.POINTER(ctype)), shape).copy()
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    lib = _require()
     buf = ct.create_string_buffer(data, len(data))
     return int(lib.ct_murmur3_x86_32(ct.cast(buf, ct.c_void_p), len(data),
                                      seed))
@@ -199,9 +211,7 @@ def row_hash(arrays: Sequence[np.ndarray],
              ) -> np.ndarray:
     """Threaded composite row hash (reference:
     HashPartitionKernel::UpdateHash, arrow_partition_kernels.hpp:199-233)."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native unavailable: {_load_error}")
+    lib = _require()
     if lengths is None:
         lengths = [None] * len(arrays)
     rows = len(arrays[0])
@@ -217,9 +227,7 @@ def partition_targets(hashes: np.ndarray, world: int
                       ) -> Tuple[np.ndarray, np.ndarray]:
     """targets + histogram from row hashes (reference:
     arrow_partition_kernels.hpp:60-70 modulo/mask partitioner)."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native unavailable: {_load_error}")
+    lib = _require()
     hashes = np.ascontiguousarray(hashes, dtype=np.uint32)
     targets = np.empty(len(hashes), dtype=np.uint32)
     hist = np.zeros(world, dtype=np.int64)
@@ -290,9 +298,7 @@ def csv_read(path, delimiter: str = ",", has_header: bool = True,
     fixed-width, 2-D uint8 for strings), ``validity`` (bool), and
     optionally ``lengths`` (int32).
     """
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native unavailable: {_load_error}")
+    lib = _require()
     opts = _CtCsvOptions(
         delimiter.encode()[:1], 1 if has_header else 0, skip_rows,
         string_width,
@@ -319,24 +325,21 @@ def csv_read(path, delimiter: str = ",", has_header: bool = True,
             vptr = lib.ct_csv_col_validity(h, i)
             col: Dict[str, np.ndarray] = {}
             if dtype.value == CT_STRING:
-                shape = (rows, width.value)
-                col["data"] = np.ctypeslib.as_array(
-                    ct.cast(dptr, ct.POINTER(ct.c_uint8)), shape).copy()
+                col["data"] = _read_buf(dptr, ct.c_uint8,
+                                        (rows, width.value), np.uint8)
                 lptr = lib.ct_csv_col_lengths(h, i)
-                col["lengths"] = np.ctypeslib.as_array(
-                    ct.cast(lptr, ct.POINTER(ct.c_int32)), (rows,)).copy()
+                col["lengths"] = _read_buf(lptr, ct.c_int32, (rows,),
+                                           np.int32)
             elif dtype.value == CT_INT64:
-                col["data"] = np.ctypeslib.as_array(
-                    ct.cast(dptr, ct.POINTER(ct.c_int64)), (rows,)).copy()
+                col["data"] = _read_buf(dptr, ct.c_int64, (rows,), np.int64)
             elif dtype.value == CT_FLOAT64:
-                col["data"] = np.ctypeslib.as_array(
-                    ct.cast(dptr, ct.POINTER(ct.c_double)), (rows,)).copy()
+                col["data"] = _read_buf(dptr, ct.c_double, (rows,),
+                                        np.float64)
             else:  # CT_BOOL
-                col["data"] = np.ctypeslib.as_array(
-                    ct.cast(dptr, ct.POINTER(ct.c_uint8)),
-                    (rows,)).astype(bool)
-            col["validity"] = np.ctypeslib.as_array(
-                ct.cast(vptr, ct.POINTER(ct.c_uint8)), (rows,)).astype(bool)
+                col["data"] = _read_buf(dptr, ct.c_uint8, (rows,),
+                                        np.uint8).astype(bool)
+            col["validity"] = _read_buf(vptr, ct.c_uint8, (rows,),
+                                        np.uint8).astype(bool)
             cols.append(col)
         return names, cols
     finally:
@@ -347,9 +350,7 @@ def csv_write(path, names: Sequence[str], arrays: Sequence[np.ndarray],
               validities: Sequence[Optional[np.ndarray]],
               lengths_list: Sequence[Optional[np.ndarray]],
               delimiter: str = ",") -> None:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native unavailable: {_load_error}")
+    lib = _require()
     rows = len(arrays[0]) if arrays else 0
     cols = []
     keepalive = []
@@ -395,9 +396,7 @@ def csv_write(path, names: Sequence[str], arrays: Sequence[np.ndarray],
 # --- registry / builder (foreign-binding surface) -----------------------
 
 def builder_begin(table_id: str) -> None:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native unavailable: {_load_error}")
+    lib = _require()
     if lib.ct_builder_begin(table_id.encode("utf-8")) != 0:
         raise RuntimeError(f"builder already open for id {table_id!r}")
 
@@ -405,7 +404,7 @@ def builder_begin(table_id: str) -> None:
 def builder_add_column(table_id: str, name: str, data: np.ndarray,
                        validity: Optional[np.ndarray] = None,
                        lengths: Optional[np.ndarray] = None) -> None:
-    lib = _load()
+    lib = _require()
     data = np.ascontiguousarray(data)
     if data.dtype == np.uint8 and data.ndim == 2:
         dtype, width, rows = CT_STRING, data.shape[1], data.shape[0]
@@ -434,7 +433,7 @@ def builder_add_column(table_id: str, name: str, data: np.ndarray,
 
 
 def builder_finish(table_id: str) -> None:
-    lib = _load()
+    lib = _require()
     if lib.ct_builder_finish(table_id.encode("utf-8")) != 0:
         raise RuntimeError(f"no open builder for id {table_id!r}")
 
@@ -447,17 +446,17 @@ def registry_contains(table_id: str) -> bool:
 
 
 def registry_remove(table_id: str) -> bool:
-    lib = _load()
+    lib = _require()
     return lib.ct_registry_remove(table_id.encode("utf-8")) == 0
 
 
 def registry_size() -> int:
-    lib = _load()
+    lib = _require()
     return int(lib.ct_registry_size())
 
 
 def registry_ids() -> List[str]:
-    lib = _load()
+    lib = _require()
     n = lib.ct_registry_ids(None, 0)
     buf = ct.create_string_buffer(int(n) + 1)
     lib.ct_registry_ids(buf, n + 1)
@@ -469,7 +468,7 @@ def registry_get(table_id: str
                  ) -> Tuple[List[str], List[Dict[str, np.ndarray]]]:
     """Zero-copy read-out of a registered table (copies into numpy on
     return so the registry entry can be dropped safely)."""
-    lib = _load()
+    lib = _require()
     tid = table_id.encode("utf-8")
     rows = lib.ct_table_rows(tid)
     if rows < 0:
@@ -491,26 +490,21 @@ def registry_get(table_id: str
         dptr = lib.ct_table_col_data(tid, i)
         col: Dict[str, np.ndarray] = {}
         if dtype.value == CT_STRING:
-            col["data"] = np.ctypeslib.as_array(
-                ct.cast(dptr, ct.POINTER(ct.c_uint8)),
-                (rows, width.value)).copy()
+            col["data"] = _read_buf(dptr, ct.c_uint8, (rows, width.value),
+                                    np.uint8)
         elif dtype.value == CT_INT64:
-            col["data"] = np.ctypeslib.as_array(
-                ct.cast(dptr, ct.POINTER(ct.c_int64)), (rows,)).copy()
+            col["data"] = _read_buf(dptr, ct.c_int64, (rows,), np.int64)
         elif dtype.value == CT_FLOAT64:
-            col["data"] = np.ctypeslib.as_array(
-                ct.cast(dptr, ct.POINTER(ct.c_double)), (rows,)).copy()
+            col["data"] = _read_buf(dptr, ct.c_double, (rows,), np.float64)
         else:
-            col["data"] = np.ctypeslib.as_array(
-                ct.cast(dptr, ct.POINTER(ct.c_uint8)),
-                (rows,)).astype(bool)
+            col["data"] = _read_buf(dptr, ct.c_uint8, (rows,),
+                                    np.uint8).astype(bool)
         if has_v.value:
             vptr = lib.ct_table_col_validity(tid, i)
-            col["validity"] = np.ctypeslib.as_array(
-                ct.cast(vptr, ct.POINTER(ct.c_uint8)), (rows,)).astype(bool)
+            col["validity"] = _read_buf(vptr, ct.c_uint8, (rows,),
+                                        np.uint8).astype(bool)
         if has_l.value:
             lptr = lib.ct_table_col_lengths(tid, i)
-            col["lengths"] = np.ctypeslib.as_array(
-                ct.cast(lptr, ct.POINTER(ct.c_int32)), (rows,)).copy()
+            col["lengths"] = _read_buf(lptr, ct.c_int32, (rows,), np.int32)
         cols.append(col)
     return names, cols

@@ -37,12 +37,17 @@ def build(verbose: bool = False) -> Path:
     if not needs_build(lib):
         return lib
     cxx = os.environ.get("CXX", "g++")
+    # compile to a process-private temp then rename: concurrent importers
+    # (multi-rank launches, pytest-xdist) must never dlopen a half-written .so
+    tmp = lib.with_name(f"{lib.name}.tmp.{os.getpid()}")
     cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-           "-o", str(lib)] + [str(s) for s in _sources()]
+           "-o", str(tmp)] + [str(s) for s in _sources()]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
         raise RuntimeError(
             f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
+    os.replace(tmp, lib)
     if verbose:
         print(f"built {lib}")
     return lib

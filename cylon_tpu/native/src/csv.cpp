@@ -13,6 +13,7 @@
 //   2. threaded field slicing  → (offset, len) per cell + per-column max len
 //   3. type inference then threaded materialization into typed buffers
 #include <algorithm>
+#include <atomic>
 #include <cctype>
 #include <charconv>
 #include <cstdint>
@@ -21,13 +22,16 @@
 #include <cstring>
 #include <memory>
 #include <mutex>
-#include <set>
 #include <strings.h>
 #include <string>
 #include <thread>
 #include <vector>
 
+#include "parallel.hpp"
+
 namespace {
+
+constexpr int64_t kRowsPerThread = 1 << 14;
 
 enum CtDType : int32_t {
   CT_INT64 = 0,
@@ -41,12 +45,20 @@ struct Options {
   bool has_header = true;
   int32_t skip_rows = 0;
   int32_t string_width = 0;  // 0 = auto
-  std::set<std::string> null_values = {"", "NULL", "null", "NaN", "nan",
-                                       "N/A", "n/a", "NA"};
+  std::vector<std::string> null_values = {"",    "NULL", "null", "NaN",
+                                          "nan", "N/A",  "n/a",  "NA"};
   bool use_quoting = true;
   char quote_char = '"';
   bool strings_can_be_null = false;  // pyarrow ConvertOptions semantics
 };
+
+bool is_null_token(const Options& o, const char* p, int32_t n) {
+  for (const std::string& s : o.null_values)
+    if (static_cast<int32_t>(s.size()) == n &&
+        std::memcmp(s.data(), p, n) == 0)
+      return true;
+  return false;
+}
 
 struct Cell {
   uint32_t off;
@@ -68,30 +80,7 @@ struct CsvResult {
   std::vector<OutCol> cols;
 };
 
-int pick_threads(int64_t rows) {
-  unsigned hw = std::thread::hardware_concurrency();
-  if (hw == 0) hw = 4;
-  int64_t by_work = rows / (1 << 14);
-  if (by_work < 1) by_work = 1;
-  return static_cast<int>(by_work < hw ? by_work : hw);
-}
-
-template <typename F>
-void parallel_rows(int64_t rows, F&& body) {
-  int nthreads = pick_threads(rows);
-  if (nthreads <= 1) {
-    body(0, rows);
-    return;
-  }
-  std::vector<std::thread> ts;
-  int64_t chunk = (rows + nthreads - 1) / nthreads;
-  for (int t = 0; t < nthreads; t++) {
-    int64_t lo = t * chunk, hi = std::min(lo + chunk, rows);
-    if (lo >= hi) break;
-    ts.emplace_back([&, lo, hi] { body(lo, hi); });
-  }
-  for (auto& t : ts) t.join();
-}
+using cylon_tpu::parallel_rows;
 
 // Split one line [lo, hi) into cells.  Returns number of fields.
 int split_line(const char* buf, uint32_t lo, uint32_t hi, const Options& o,
@@ -132,21 +121,25 @@ int split_line(const char* buf, uint32_t lo, uint32_t hi, const Options& o,
   return n;
 }
 
-// Copy a cell's bytes un-escaping doubled quotes; returns length written.
-int32_t unescape(const char* buf, const Cell& c, char q, char* out,
-                 int32_t cap) {
-  if (!c.quoted) {
-    int32_t n = std::min(c.len, cap);
-    std::memcpy(out, buf + c.off, n);
-    return n;
-  }
+// A cell's bytes: a direct view into the file buffer for unquoted cells;
+// quoted cells are unescaped (doubled quotes collapsed) into `scratch`.
+// No length cap — scratch grows to the cell size.
+struct CellView {
+  const char* p;
+  int32_t n;
+};
+
+CellView cell_view(const char* buf, const Cell& c, char q,
+                   std::vector<char>& scratch) {
+  if (!c.quoted) return {buf + c.off, c.len};
+  if (static_cast<int32_t>(scratch.size()) < c.len) scratch.resize(c.len);
   int32_t n = 0;
-  for (int32_t i = 0; i < c.len && n < cap; i++) {
+  for (int32_t i = 0; i < c.len; i++) {
     char ch = buf[c.off + i];
-    out[n++] = ch;
+    scratch[n++] = ch;
     if (ch == q && i + 1 < c.len && buf[c.off + i + 1] == q) i++;
   }
-  return n;
+  return {scratch.data(), n};
 }
 
 bool parse_i64(const char* p, int32_t len, int64_t* out) {
@@ -220,7 +213,7 @@ void* ct_csv_read(const char* path, const CtCsvOptions* copts, char* err,
       const char* p = copts->null_values;
       while (true) {
         const char* nl = std::strchr(p, '\n');
-        o.null_values.emplace(p, nl ? nl - p : std::strlen(p));
+        o.null_values.emplace_back(p, nl ? nl - p : std::strlen(p));
         if (!nl) break;
         p = nl + 1;
       }
@@ -232,6 +225,12 @@ void* ct_csv_read(const char* path, const CtCsvOptions* copts, char* err,
   std::fseek(f, 0, SEEK_END);
   long fsize = std::ftell(f);
   std::fseek(f, 0, SEEK_SET);
+  // cell/line offsets are uint32 — reject files they cannot address (the
+  // Python layer falls back to the pyarrow reader)
+  if (static_cast<uint64_t>(fsize) > UINT32_MAX - 1) {
+    std::fclose(f);
+    return fail("file exceeds native reader's 4GiB limit");
+  }
   std::vector<char> buf(fsize);
   if (fsize && std::fread(buf.data(), 1, fsize, f) != (size_t)fsize) {
     std::fclose(f);
@@ -278,10 +277,10 @@ void* ct_csv_read(const char* path, const CtCsvOptions* copts, char* err,
     std::vector<Cell> cells;
     ncols = split_line(buf.data(), starts[first], ends[first], o, cells);
     if (o.has_header) {
-      char tmp[4096];
+      std::vector<char> scratch;
       for (const Cell& c : cells) {
-        int32_t n = unescape(buf.data(), c, o.quote_char, tmp, sizeof(tmp));
-        names.emplace_back(tmp, n);
+        CellView v = cell_view(buf.data(), c, o.quote_char, scratch);
+        names.emplace_back(v.p, v.n);
       }
       first++;
     } else {
@@ -300,7 +299,7 @@ void* ct_csv_read(const char* path, const CtCsvOptions* copts, char* err,
   std::vector<int32_t> maxlen(ncols, 0);
   std::string bad_row;
   std::mutex m;
-  parallel_rows(rows, [&](int64_t lo, int64_t hi) {
+  parallel_rows(rows, kRowsPerThread, [&](int64_t lo, int64_t hi) {
     std::vector<Cell> line;
     std::vector<int32_t> local_max(ncols, 0);
     for (int64_t r = lo; r < hi; r++) {
@@ -324,23 +323,31 @@ void* ct_csv_read(const char* path, const CtCsvOptions* copts, char* err,
   });
   if (!bad_row.empty()) return fail(bad_row);
 
-  // phase 3a: type inference (whole column; nulls don't break a type)
-  char tmp[4096];
+  // phase 3a: threaded type inference (whole column; nulls don't break a
+  // type).  Each thread scans a row range with local flags and stops once
+  // every candidate type is ruled out for its range.
   for (int c = 0; c < ncols; c++) {
-    bool ok_i64 = true, ok_f64 = true, ok_bool = true, any = false;
-    for (int64_t r = 0; r < rows && (ok_i64 || ok_f64 || ok_bool); r++) {
-      const Cell& cell = cells[r * ncols + c];
-      int32_t n = unescape(buf.data(), cell, o.quote_char, tmp, sizeof(tmp));
-      std::string s(tmp, n);
-      if (!cell.quoted && o.null_values.count(s)) continue;
-      any = true;
-      int64_t iv;
-      double dv;
-      bool bv;
-      if (ok_i64 && !parse_i64(tmp, n, &iv)) ok_i64 = false;
-      if (ok_f64 && !parse_f64(tmp, n, &dv)) ok_f64 = false;
-      if (ok_bool && !parse_bool(tmp, n, &bv)) ok_bool = false;
-    }
+    std::atomic<bool> ok_i64{true}, ok_f64{true}, ok_bool{true}, any{false};
+    parallel_rows(rows, kRowsPerThread, [&](int64_t lo, int64_t hi) {
+      std::vector<char> scratch;
+      bool li = true, lf = true, lb = true, la = false;
+      for (int64_t r = lo; r < hi && (li || lf || lb); r++) {
+        const Cell& cell = cells[r * ncols + c];
+        CellView v = cell_view(buf.data(), cell, o.quote_char, scratch);
+        if (!cell.quoted && is_null_token(o, v.p, v.n)) continue;
+        la = true;
+        int64_t iv;
+        double dv;
+        bool bv;
+        if (li && !parse_i64(v.p, v.n, &iv)) li = false;
+        if (lf && !parse_f64(v.p, v.n, &dv)) lf = false;
+        if (lb && !parse_bool(v.p, v.n, &bv)) lb = false;
+      }
+      if (!li) ok_i64 = false;
+      if (!lf) ok_f64 = false;
+      if (!lb) ok_bool = false;
+      if (la) any = true;
+    });
     OutCol& col = res.cols[c];
     if (!any) col.dtype = CT_STRING;          // all-null → string
     else if (ok_i64) col.dtype = CT_INT64;
@@ -367,15 +374,14 @@ void* ct_csv_read(const char* path, const CtCsvOptions* copts, char* err,
     col.data.assign(static_cast<size_t>(rows) * col.width, 0);
     col.validity.assign(rows, 1);
   }
-  parallel_rows(rows, [&](int64_t lo, int64_t hi) {
-    char fld[4096];
+  parallel_rows(rows, kRowsPerThread, [&](int64_t lo, int64_t hi) {
+    std::vector<char> scratch;
     for (int64_t r = lo; r < hi; r++) {
       for (int c = 0; c < ncols; c++) {
         OutCol& col = res.cols[c];
         const Cell& cell = cells[r * ncols + c];
-        int32_t n = unescape(buf.data(), cell, o.quote_char, fld, sizeof(fld));
-        std::string s(fld, n);
-        bool is_null = !cell.quoted && o.null_values.count(s) &&
+        CellView v = cell_view(buf.data(), cell, o.quote_char, scratch);
+        bool is_null = !cell.quoted && is_null_token(o, v.p, v.n) &&
                        (col.dtype != CT_STRING || o.strings_can_be_null);
         if (is_null) {
           col.validity[r] = 0;
@@ -383,26 +389,28 @@ void* ct_csv_read(const char* path, const CtCsvOptions* copts, char* err,
         }
         switch (col.dtype) {
           case CT_INT64: {
-            int64_t v = 0;
-            parse_i64(fld, n, &v);
-            std::memcpy(col.data.data() + r * 8, &v, 8);
+            int64_t val = 0;
+            parse_i64(v.p, v.n, &val);
+            std::memcpy(col.data.data() + r * 8, &val, 8);
             break;
           }
           case CT_FLOAT64: {
-            double v = 0;
-            parse_f64(fld, n, &v);
-            std::memcpy(col.data.data() + r * 8, &v, 8);
+            double val = 0;
+            parse_f64(v.p, v.n, &val);
+            std::memcpy(col.data.data() + r * 8, &val, 8);
             break;
           }
           case CT_BOOL: {
-            bool v = false;
-            parse_bool(fld, n, &v);
-            col.data[r] = v ? 1 : 0;
+            bool val = false;
+            parse_bool(v.p, v.n, &val);
+            col.data[r] = val ? 1 : 0;
             break;
           }
           case CT_STRING: {
-            int32_t w = std::min(n, col.width);
-            std::memcpy(col.data.data() + (int64_t)r * col.width, fld, w);
+            // truncation only when an explicit string_width option narrows
+            // the column below the observed max length
+            int32_t w = std::min(v.n, col.width);
+            std::memcpy(col.data.data() + (int64_t)r * col.width, v.p, w);
             col.lengths[r] = w;
             break;
           }

@@ -15,38 +15,13 @@
 #include <vector>
 
 #include "murmur3.hpp"
+#include "parallel.hpp"
 
 namespace cylon_tpu {
 namespace {
 
 constexpr uint32_t kSeed = 0;
-
-inline int pick_threads(int64_t rows) {
-  unsigned hw = std::thread::hardware_concurrency();
-  if (hw == 0) hw = 4;
-  int64_t by_work = rows / (1 << 16);  // >=64K rows per thread
-  if (by_work < 1) by_work = 1;
-  return static_cast<int>(by_work < hw ? by_work : hw);
-}
-
-template <typename F>
-void parallel_rows(int64_t rows, F&& body) {
-  int nthreads = pick_threads(rows);
-  if (nthreads <= 1) {
-    body(0, rows);
-    return;
-  }
-  std::vector<std::thread> ts;
-  ts.reserve(nthreads);
-  int64_t chunk = (rows + nthreads - 1) / nthreads;
-  for (int t = 0; t < nthreads; t++) {
-    int64_t lo = t * chunk;
-    int64_t hi = lo + chunk < rows ? lo + chunk : rows;
-    if (lo >= hi) break;
-    ts.emplace_back([&, lo, hi] { body(lo, hi); });
-  }
-  for (auto& t : ts) t.join();
-}
+constexpr int64_t kRowsPerThread = 1 << 16;  // >=64K rows per thread
 
 }  // namespace
 }  // namespace cylon_tpu
@@ -77,7 +52,7 @@ struct CtHashCol {
 // arrow_partition_kernels.hpp:199-233).
 void ct_row_hash(const CtHashCol* cols, int32_t ncols, int64_t rows,
                  uint32_t* hashes) {
-  cylon_tpu::parallel_rows(rows, [&](int64_t lo, int64_t hi) {
+  cylon_tpu::parallel_rows(rows, cylon_tpu::kRowsPerThread, [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; i++) hashes[i] = 1;
     for (int32_t c = 0; c < ncols; c++) {
       const CtHashCol& col = cols[c];
@@ -102,7 +77,7 @@ void ct_partition_targets(const uint32_t* hashes, int64_t rows, int32_t world,
   uint32_t mask = static_cast<uint32_t>(world - 1);
   std::vector<std::vector<int64_t>> partials;
   std::mutex m;
-  cylon_tpu::parallel_rows(rows, [&](int64_t lo, int64_t hi) {
+  cylon_tpu::parallel_rows(rows, cylon_tpu::kRowsPerThread, [&](int64_t lo, int64_t hi) {
     std::vector<int64_t> hist(world, 0);
     if (pow2) {
       for (int64_t i = lo; i < hi; i++) {

@@ -188,3 +188,16 @@ def test_isin_null_semantics(local_ctx):
     t = Table.from_pydict({"s": ["a", None, "b"]}, ctx=local_ctx)
     assert t.isin(["", "a"]).to_pydict()["s"] == [True, False, False]
     assert t.isin(["a", None], skip_null=False).to_pydict()["s"] == [True, True, False]
+
+
+def test_where_other_keeps_padding_invalid(local_ctx):
+    """where(other=) must not mark capacity-padding rows valid."""
+    import jax.numpy as jnp
+    from cylon_tpu import Table
+
+    t = Table.from_pydict({"a": [1.0, 2.0]}, ctx=local_ctx, capacity=8)
+    cond = t > 5.0
+    out = t.where(cond, 9.0)
+    col = out.columns[0]
+    assert not bool(jnp.any(col.validity[2:]))
+    assert out.to_pydict()["a"] == [9.0, 9.0]

@@ -207,3 +207,41 @@ def test_registry_string_column():
         assert got == [b"hi", b"bye"]
     finally:
         native.registry_remove("reg_s")
+
+
+def test_csv_long_field_not_truncated(tmp_path):
+    """Fields longer than any fixed scratch size read back intact."""
+    big = "x" * 5000
+    p = tmp_path / "long.csv"
+    p.write_text(f"k,s\n1,{big}\n2,yy\n")
+    _, cols = native.csv_read(p)
+    lens = cols[1]["lengths"]
+    assert int(lens[0]) == 5000
+    assert bytes(cols[1]["data"][0][:5000]) == big.encode()
+
+
+def test_csv_long_quoted_field_unescaped(tmp_path):
+    big = 'ab""' * 2000  # unescapes to 6000 chars
+    p = tmp_path / "longq.csv"
+    p.write_text(f'k,s\n1,"{big}"\n')
+    _, cols = native.csv_read(p)
+    assert int(cols[1]["lengths"][0]) == 6000
+    assert bytes(cols[1]["data"][0][:6]) == b'ab"ab"'
+
+
+def test_csv_header_only(tmp_path):
+    p = tmp_path / "empty.csv"
+    p.write_text("a,b,c\n")
+    names, cols = native.csv_read(p)
+    assert names == ["a", "b", "c"]
+    assert all(len(c["data"]) == 0 for c in cols)
+
+
+def test_header_only_table(tmp_path):
+    from cylon_tpu import Table
+
+    p = tmp_path / "empty2.csv"
+    p.write_text("a,b\n")
+    t = Table.from_csv(p)
+    assert t.row_count == 0
+    assert t.column_names == ["a", "b"]
