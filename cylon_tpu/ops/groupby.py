@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from .. import dtypes
 from ..column import Column
-from . import keys
+from . import keys, segments
 
 
 class AggOp(enum.IntEnum):
@@ -90,9 +90,21 @@ def _agg_out_dtype(op: AggOp, dt: dtypes.DataType):
     return dt  # MIN/MAX keep the input type
 
 
-def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int, ddof: int):
-    """One masked segment reduction; returns (values, validity_counts)."""
-    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid, num_segments)
+def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
+                       ddof: int):
+    """One masked segment reduction; returns (values, validity_counts).
+
+    All reductions are ``jax.ops.segment_*`` scatters with 32-bit operands
+    wherever the semantics allow (counts accumulate i32 and widen after;
+    f32 sums stay f32, matching the reference's KernelTraits accumulator of
+    the input type) — 64-bit scatters profile ~8x slower on TPU, and the
+    prefix-sum alternative (cumsum + boundary gather) SIGSEGVs/hangs this
+    XLA TPU backend whenever several 64-bit prefix programs share one
+    multi-aggregation fusion.  Only ops whose semantics require double
+    accumulation (MEAN/VAR/STDDEV/SUMSQ, f64/int64 SUM) pay the 64-bit
+    scatter."""
+    cnt32 = jax.ops.segment_sum(valid.astype(jnp.int32), gid, num_segments)
+    cnt = cnt32.astype(jnp.int64)
     if op == AggOp.COUNT:
         return cnt, cnt
     if op == AggOp.SUMSQ:
@@ -101,7 +113,8 @@ def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int, ddof: int
     if op == AggOp.SUM:
         acc = jnp.where(valid, data, jnp.zeros((), data.dtype))
         if jnp.issubdtype(data.dtype, jnp.floating):
-            acc = acc.astype(jnp.float64 if data.dtype == jnp.float64 else jnp.float32)
+            acc = acc.astype(jnp.float64 if data.dtype == jnp.float64
+                             else jnp.float32)
         else:
             acc = acc.astype(jnp.int64)
         return jax.ops.segment_sum(acc, gid, num_segments), cnt
@@ -152,15 +165,16 @@ def hash_groupby(cols: Tuple[Column, ...], count,
     key_cols = [cols[i] for i in key_idx]
     operands = keys.build_operands(key_cols, count, cap)
     perm, sorted_ops = keys.lexsort_indices(operands, cap)
-    gid, _ = keys.dense_group_ids(sorted_ops)
+    new_group = ~keys.rows_equal_adjacent(sorted_ops)
+    gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    start, end = segments.segment_spans(new_group)
     iota = jnp.arange(cap, dtype=jnp.int32)
     live = iota < count  # padding sorted last -> first `count` sorted rows live
     num_groups = jnp.where(
         count > 0, jnp.take(gid, jnp.clip(count - 1, 0, cap - 1)) + 1, 0)
 
     # group leader positions (first sorted row of each group)
-    leader = jax.ops.segment_min(iota, gid, cap)
-    leader = jnp.clip(leader, 0, cap - 1)
+    leader = jnp.clip(start, 0, cap - 1)
     group_live = iota[:cap] < num_groups
 
     out_cols = []
@@ -176,7 +190,8 @@ def hash_groupby(cols: Tuple[Column, ...], count,
         else:
             if vcol.is_string:
                 raise TypeError(f"aggregation {op.name} unsupported on strings")
-            vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid, cap, ddof)
+            vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid,
+                                            cap, ddof)
         validity = group_live & (cnts > 0)
         vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
         out_cols.append(Column(vals, validity, None,
@@ -192,8 +207,9 @@ def _nunique(vcol: Column, vvalid, gid, cap: int):
     svalid = sorted_ops[0] == 0
     gsorted = sorted_ops[1]
     new_distinct = (~eq) & svalid
-    cnt = jax.ops.segment_sum(new_distinct.astype(jnp.int64), gsorted, cap)
-    return cnt, cnt
+    # i32 scatter-add, widened after: 64-bit scatters are ~8x slower on TPU
+    cnt = jax.ops.segment_sum(new_distinct.astype(jnp.int32), gsorted, cap)
+    return cnt.astype(jnp.int64), cnt
 
 
 @partial(jax.jit, static_argnames=("key_idx", "aggs", "ddof"))
@@ -208,12 +224,14 @@ def pipeline_groupby(cols: Tuple[Column, ...], count,
     operands = [keys.padding_operand(cap, count)]
     for kc in key_cols:
         operands.extend(keys.column_operands(kc))
-    gid, _ = keys.dense_group_ids(operands)
+    new_group = ~keys.rows_equal_adjacent(operands)
+    gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    start, end = segments.segment_spans(new_group)
     iota = jnp.arange(cap, dtype=jnp.int32)
     live = iota < count
     num_groups = jnp.where(
         count > 0, jnp.take(gid, jnp.clip(count - 1, 0, cap - 1)) + 1, 0)
-    leader = jnp.clip(jax.ops.segment_min(iota, gid, cap), 0, cap - 1)
+    leader = jnp.clip(start, 0, cap - 1)
     group_live = iota < num_groups
 
     out_cols = []
@@ -227,7 +245,8 @@ def pipeline_groupby(cols: Tuple[Column, ...], count,
         else:
             if vcol.is_string:
                 raise TypeError(f"aggregation {op.name} unsupported on strings")
-            vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid, cap, ddof)
+            vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid,
+                                            cap, ddof)
         validity = group_live & (cnts > 0)
         vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
         out_cols.append(Column(vals, validity, None,

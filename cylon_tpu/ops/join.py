@@ -37,10 +37,16 @@ from . import common, compact
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
 
-def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on):
+def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
+                  join_type: JoinType):
     """Compute per-left-row match ranges into a gid-sorted right table.
 
-    Returns (lo, hi, perm_r, live_l, unmatched_right_mask, gid machinery).
+    Both sides share dense group ids from one combined lexsort, so the match
+    range of a left row is pure integer arithmetic: a per-gid histogram of
+    live right rows (one int32 scatter-add — 64-bit scatters and
+    searchsorted binary searches both profile ~10x slower on TPU) prefix-
+    summed into start offsets.  Returns
+    (lo, matches, perm_r, live_l, unmatched_right_mask).
     """
     cap_l = cols_l[0].data.shape[0]
     cap_r = cols_r[0].data.shape[0]
@@ -49,22 +55,29 @@ def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on):
 
     live_l = jnp.arange(cap_l, dtype=jnp.int32) < count_l
     live_r = jnp.arange(cap_r, dtype=jnp.int32) < count_r
+    n_gid = cap_l + cap_r
 
-    # padding rows (either side) share a gid; exile right padding to +inf key
+    # per-gid live right-row histogram -> start offsets in gid-sorted order
+    ones_r = live_r.astype(jnp.int32)
+    counts_r = jnp.zeros((n_gid,), jnp.int32).at[gid_r].add(ones_r)
+    csum_r = jnp.cumsum(counts_r, dtype=jnp.int32)
+    rstart = jnp.concatenate([jnp.zeros((1,), jnp.int32), csum_r[:-1]])
+    lo = jnp.take(rstart, gid_l)
+    matches = jnp.where(live_l, jnp.take(counts_r, gid_l), 0)
+
+    # right rows ordered by gid, live rows first (padding exiled to +inf);
+    # rstart[g] indexes into exactly this order
     rkey = jnp.where(live_r, gid_r, _I32_MAX)
     iota_r = jnp.arange(cap_r, dtype=jnp.int32)
-    rkey_sorted, perm_r = jax.lax.sort((rkey, iota_r), num_keys=1, is_stable=True)
+    _, perm_r = jax.lax.sort((rkey, iota_r), num_keys=1, is_stable=True)
 
-    lo = jnp.searchsorted(rkey_sorted, gid_l, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(rkey_sorted, gid_l, side="right").astype(jnp.int32)
-    matches = jnp.where(live_l, hi - lo, 0)
-
-    # right rows with no left partner (for RIGHT/FULL_OUTER)
-    lkey = jnp.where(live_l, gid_l, _I32_MAX)
-    lkey_sorted = jax.lax.sort((lkey,), num_keys=1)[0]
-    l_lo = jnp.searchsorted(lkey_sorted, gid_r, side="left")
-    l_hi = jnp.searchsorted(lkey_sorted, gid_r, side="right")
-    unmatched_r = live_r & (l_hi == l_lo)
+    # right rows with no left partner — only RIGHT/FULL_OUTER pay for it
+    if join_type in (JoinType.RIGHT, JoinType.FULL_OUTER):
+        counts_l = jnp.zeros((n_gid,), jnp.int32).at[gid_l].add(
+            live_l.astype(jnp.int32))
+        unmatched_r = live_r & (jnp.take(counts_l, gid_r) == 0)
+    else:
+        unmatched_r = jnp.zeros((cap_r,), bool)
     return lo, matches, perm_r, live_l, unmatched_r
 
 
@@ -83,7 +96,7 @@ def join_row_count(cols_l: Tuple[Column, ...], count_l,
                    join_type: JoinType):
     """Exact output row count of the join (device scalar)."""
     lo, matches, perm_r, live_l, unmatched_r = _match_ranges(
-        cols_l, count_l, cols_r, count_r, left_on, right_on)
+        cols_l, count_l, cols_r, count_r, left_on, right_on, join_type)
     _, _, total = _emission(matches, live_l, join_type)
     if join_type in (JoinType.RIGHT, JoinType.FULL_OUTER):
         total = total + jnp.sum(unmatched_r, dtype=jnp.int32)
@@ -98,11 +111,13 @@ def join_gather(cols_l: Tuple[Column, ...], count_l,
     """Produce gathered output columns (left columns ++ right columns) with
     capacity ``out_capacity`` and the dynamic output row count."""
     lo, matches, perm_r, live_l, unmatched_r = _match_ranges(
-        cols_l, count_l, cols_r, count_r, left_on, right_on)
+        cols_l, count_l, cols_r, count_r, left_on, right_on, join_type)
     emit, csum, total = _emission(matches, live_l, join_type)
 
     k = jnp.arange(out_capacity, dtype=jnp.int32)
-    li = jnp.searchsorted(csum, k, side="right").astype(jnp.int32)
+    # method='sort' rides the TPU sort unit instead of a 22-step binary
+    # search of gathers
+    li = jnp.searchsorted(csum, k, side="right", method="sort").astype(jnp.int32)
     li = jnp.clip(li, 0, csum.shape[0] - 1)
     base = csum[li] - emit[li]
     within = k - base

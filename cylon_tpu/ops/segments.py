@@ -1,0 +1,75 @@
+"""Sorted-segment reductions without scatter.
+
+``jax.ops.segment_*`` lowers to scatter-add, which XLA serializes on TPU —
+profiled at ~0.8 s for a 4M-row float64/int64 scatter vs ~70 ms for a
+float64 cumsum of the same length.  Every segment reduction in this
+framework runs over rows *already sorted by group id* (group ids come from a
+lexsort — ops/keys.dense_group_ids), so the TPU-native formulation is:
+
+    sum over segment g  =  csum[end_g] - csum[start_g]
+
+with segment spans recovered once per groupby from the group-boundary mask
+via a single mask-compaction sort (ops/compact.compact_indices).  This is
+the replacement for the reference's per-group accumulator State streaming
+(cpp/src/cylon/groupby/hash_groupby.cpp:135-192 aggregate<op,T> and
+compute/aggregate_kernels.hpp KernelTraits): the prefix sum *is* the
+running state, evaluated for all groups at once.
+
+MIN/MAX keep ``jax.ops.segment_min/max`` — their operands stay in the input
+dtype (int32/float32 scatters profile ~8x faster than 64-bit ones) and have
+no cancellation-safe prefix formulation.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import compact
+
+
+def segment_spans(new_group: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-segment [start, end) positions from a group-boundary mask.
+
+    ``new_group[i]`` is True where sorted row i starts a new segment
+    (position 0 must be True for any nonempty input).  Returns
+    (start[cap], end[cap]) where segment g spans rows [start[g], end[g]);
+    ids >= the number of segments get empty spans at cap.
+    """
+    cap = new_group.shape[0]
+    starts_perm, num = compact.compact_indices(new_group)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    start = jnp.where(iota < num, starts_perm, cap)
+    end = jnp.concatenate([start[1:], jnp.full((1,), cap, jnp.int32)])
+    return start, end
+
+
+def _span_take(csum0: jax.Array, pos: jax.Array) -> jax.Array:
+    return jnp.take(csum0, pos, mode="clip")
+
+
+def segment_sum_sorted(x: jax.Array, start: jax.Array, end: jax.Array,
+                       acc_dtype=None) -> jax.Array:
+    """Segment sums via prefix sum + boundary gather.  ``x`` must already be
+    masked (padding/null rows zeroed).  ``acc_dtype`` defaults to a wide
+    accumulator (f64 for floats, i64 for ints) — the prefix sum over the
+    whole column needs the headroom even when per-segment sums are small."""
+    if acc_dtype is None:
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            acc_dtype = jnp.float64
+        elif x.dtype == jnp.bool_:
+            acc_dtype = jnp.int32
+        else:
+            acc_dtype = jnp.int64
+    csum = jnp.cumsum(x.astype(acc_dtype))
+    csum0 = jnp.concatenate([jnp.zeros((1,), acc_dtype), csum])
+    return _span_take(csum0, end) - _span_take(csum0, start)
+
+
+def segment_count_sorted(valid: jax.Array, start: jax.Array,
+                         end: jax.Array) -> jax.Array:
+    """Number of True rows per segment (int64, matching the reference's
+    COUNT output type)."""
+    return segment_sum_sorted(valid.astype(jnp.int32), start, end,
+                              jnp.int32).astype(jnp.int64)
