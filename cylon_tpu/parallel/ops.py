@@ -137,7 +137,19 @@ def distributed_sort(t, by_idx: Tuple[int, ...], opts: SortOptions,
 # ---------------------------------------------------------------------------
 
 def distributed_groupby(t, by_idx: Tuple[int, ...],
-                        aggs: Tuple[Tuple[int, AggOp], ...], ddof: int):
+                        aggs: Tuple[Tuple[int, AggOp], ...], ddof: int,
+                        pipeline: bool = False):
+    """Two-phase distributed group-by.
+
+    ``pipeline=False`` — the reference's DistributedHashGroupBy
+    (groupby/groupby.cpp:23-73): local partial aggregate, shuffle partials
+    on the keys, final combine.
+    ``pipeline=True`` — DistributedPipelineGroupBy (groupby/groupby.cpp:
+    75-114): the local phases run the boundary-scan pipeline group-by over
+    key-sorted rows; after the shuffle each shard sorts its received
+    partials before the final pipeline pass (the reference's local Sort at
+    groupby.cpp:103-107).
+    """
     from ..table import Table, _groupby_output_names, _local_groupby, _shard_wise
 
     if any(op == AggOp.NUNIQUE for _, op in aggs):
@@ -159,15 +171,19 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
     nkeys = len(by_idx)
 
     # 2. local partial aggregate (per shard)
+    local_partial = (groupby_mod.pipeline_groupby if pipeline
+                     else groupby_mod.hash_groupby)
+
     def partial_fn(tt):
-        cols, m = groupby_mod.hash_groupby(
+        cols, m = local_partial(
             tt.columns, tt.row_counts[0], tuple(by_idx), tuple(partial_list), ddof)
         pnames = tuple(f"k{i}" for i in range(nkeys)) + tuple(
             f"p{i}" for i in range(len(partial_list)))
         return Table(cols, jnp.reshape(m, (1,)), pnames, ctx)
 
     partial = _shard_map(ctx, partial_fn,
-                         ("gb_partial", tuple(by_idx), tuple(partial_list), ddof),
+                         ("gb_partial", tuple(by_idx), tuple(partial_list),
+                          ddof, pipeline),
                          _shapes_key(t))(t)
 
     # 3. shuffle partials on the key columns
@@ -176,14 +192,22 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
     # 4. final combine: SUM of sums/counts/sumsqs, MIN of mins, MAX of maxes
     final_aggs = tuple((nkeys + i, groupby_mod.combine_op(pop))
                        for i, (_, pop) in enumerate(partial_list))
+    key_range = tuple(range(nkeys))
 
     def final_fn(tt):
-        cols, m = groupby_mod.hash_groupby(
-            tt.columns, tt.row_counts[0], tuple(range(nkeys)), final_aggs, ddof)
+        cols, count = tt.columns, tt.row_counts[0]
+        if pipeline:  # received partials arrive unsorted: sort, then scan
+            cols, count = sort_mod.sort_rows(
+                cols, count, key_range, tuple([True] * nkeys), True)
+            cols, m = groupby_mod.pipeline_groupby(
+                cols, count, key_range, final_aggs, ddof)
+        else:
+            cols, m = groupby_mod.hash_groupby(
+                cols, count, key_range, final_aggs, ddof)
         return cols, jnp.reshape(m, (1,))
 
     fcols, fcounts = _shard_map(
-        ctx, final_fn, ("gb_final", tuple(range(nkeys)), final_aggs, ddof),
+        ctx, final_fn, ("gb_final", key_range, final_aggs, ddof, pipeline),
         _shapes_key(shuffled))(shuffled)
 
     # 5. finalize derived outputs (MEAN/VAR/STDDEV) from combined partials

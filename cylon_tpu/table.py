@@ -425,10 +425,19 @@ class Table:
 
     # -- groupby -------------------------------------------------------
     def groupby(self, by, agg: Dict[ColumnRef, Union[str, Sequence[str]]],
-                ddof: int = 0) -> "Table":
-        """Hash group-by (reference: DistributedHashGroupBy, groupby/
-        groupby.cpp:23-73): local partial aggregate, shuffle on keys, final
-        aggregate.  Local-only when the table has one shard."""
+                ddof: int = 0, groupby_type: str = "hash") -> "Table":
+        """Group-by with two-phase distributed execution.
+
+        ``groupby_type="hash"`` — the reference's DistributedHashGroupBy
+        (groupby/groupby.cpp:23-73): local partial aggregate, shuffle on
+        keys, final aggregate.  ``groupby_type="pipeline"`` —
+        DistributedPipelineGroupBy (groupby/groupby.cpp:75-114): boundary-
+        scan group-by over key-sorted rows (the caller guarantees each
+        shard is sorted on the keys, as the reference does).  Local-only
+        when the table has one shard."""
+        if groupby_type not in ("hash", "pipeline"):
+            raise CylonError(Code.Invalid,
+                             f"bad groupby_type {groupby_type!r}")
         by_idx = self._resolve_many(by)
         aggs: List[Tuple[int, AggOp]] = []
         for ref, ops in agg.items():
@@ -437,11 +446,13 @@ class Table:
                 ops = [ops]
             for op in ops:
                 aggs.append((ci, AggOp.of(op)))
+        pipeline = groupby_type == "pipeline"
         if self.num_shards == 1:
-            return _local_groupby(self, by_idx, tuple(aggs), ddof)
+            return _local_groupby(self, by_idx, tuple(aggs), ddof, pipeline)
         from .parallel import ops as par_ops
 
-        return par_ops.distributed_groupby(self, by_idx, tuple(aggs), ddof)
+        return par_ops.distributed_groupby(self, by_idx, tuple(aggs), ddof,
+                                           pipeline)
 
     # -- scalar aggregates ---------------------------------------------
     def sum(self, ref: ColumnRef):
@@ -855,16 +866,18 @@ def _dist_set_op(a: Table, b: Table, op: str) -> Table:
 
 
 def _local_groupby(t: Table, by_idx: Tuple[int, ...],
-                   aggs: Tuple[Tuple[int, AggOp], ...], ddof: int) -> Table:
+                   aggs: Tuple[Tuple[int, AggOp], ...], ddof: int,
+                   pipeline: bool = False) -> Table:
     names = _groupby_output_names(t, by_idx, aggs)
     ctx = t.ctx
+    local = (groupby_mod.pipeline_groupby if pipeline
+             else groupby_mod.hash_groupby)
 
     def fn(tt: Table) -> Table:
-        cols, m = groupby_mod.hash_groupby(tt.columns, tt.row_counts[0], by_idx,
-                                           aggs, ddof)
+        cols, m = local(tt.columns, tt.row_counts[0], by_idx, aggs, ddof)
         return Table(cols, jnp.reshape(m, (1,)), names, ctx)
 
-    return _shard_wise(ctx, fn, t, key=("groupby", by_idx, aggs, ddof))
+    return _shard_wise(ctx, fn, t, key=("groupby", by_idx, aggs, ddof, pipeline))
 
 
 def _groupby_output_names(t: Table, by_idx, aggs) -> Tuple[str, ...]:

@@ -95,3 +95,42 @@ def test_groupby_single_group(local_ctx):
     g = t.groupby("g", {"v": "mean"})
     assert g.row_count == 1
     assert g.to_pydict()["mean_v"] == [2.0]
+
+
+def test_distributed_pipeline_groupby(ctx4, rng):
+    """DistributedPipelineGroupBy (reference: groupby/groupby.cpp:75-114):
+    per-shard key-sorted input -> pipeline partial -> shuffle -> sort ->
+    pipeline final; must agree with the hash path and pandas."""
+    import pandas as pd
+    from cylon_tpu import Table
+    from tests.utils import assert_rows_equal
+
+    n = 400
+    k = np.sort(rng.integers(0, 40, n)).astype(np.int64)  # pre-sorted keys
+    v = rng.random(n)
+    df = pd.DataFrame({"k": k, "v": v})
+    # each shard must individually be key-sorted: distribute contiguous runs
+    t = Table.from_pydict({"k": k, "v": v}, ctx=ctx4)
+
+    out = t.groupby("k", {"v": ["sum", "mean", "count"]},
+                    groupby_type="pipeline")
+    ref = (df.groupby("k").agg(sum_v=("v", "sum"), mean_v=("v", "mean"),
+                               count_v=("v", "count")).reset_index())
+    assert_rows_equal(out, ref, ndigits=6)
+
+    hash_out = t.groupby("k", {"v": ["sum", "mean", "count"]})
+    assert hash_out.row_count == out.row_count
+
+
+def test_local_pipeline_groupby_table(local_ctx, rng):
+    import pandas as pd
+    from cylon_tpu import Table
+    from tests.utils import assert_rows_equal
+
+    k = np.sort(rng.integers(0, 11, 100)).astype(np.int64)
+    v = rng.random(100)
+    t = Table.from_pydict({"k": k, "v": v}, ctx=local_ctx)
+    out = t.groupby("k", {"v": ["min", "max"]}, groupby_type="pipeline")
+    ref = (pd.DataFrame({"k": k, "v": v}).groupby("k")
+           .agg(min_v=("v", "min"), max_v=("v", "max")).reset_index())
+    assert_rows_equal(out, ref, ndigits=9)
