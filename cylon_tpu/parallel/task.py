@@ -1,0 +1,146 @@
+"""Task-multiplexed all-to-all: many logical tables, one collective pass.
+
+TPU-native replacement for the reference's ArrowTaskAllToAll
+(cpp/src/cylon/arrow/arrow_task_all_to_all.h:9-59, .cpp): there, a
+``LogicalTaskPlan`` maps logical task ids onto workers so several logical
+tables share one worker's MPI channels, with mutex-guarded inserts and a
+``WaitForCompletion`` spin.  Here the multiplexing is data-level: every
+logical table's rows are tagged with their task id, concatenated, and moved
+in ONE fused shuffle (single ``lax.all_to_all`` pass over ICI) whose routing
+function is the plan's task->worker lookup instead of a key hash.  The
+mutexes and completion spins have no equivalent — SPMD program order is the
+synchronization.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..status import CylonError, Code
+
+TASK_COL = "__task__"
+
+
+class LogicalTaskPlan:
+    """task id -> worker (shard) assignment (reference:
+    arrow_task_all_to_all.h:9-24 LogicalTaskPlan's task_source_of/
+    worker_num_of maps)."""
+
+    def __init__(self, task_to_worker: Dict[int, int], world_size: int):
+        for task, worker in task_to_worker.items():
+            if not 0 <= worker < world_size:
+                raise CylonError(
+                    Code.Invalid,
+                    f"task {task} assigned to worker {worker} outside world "
+                    f"of {world_size}")
+        self._map = dict(task_to_worker)
+        self.world_size = world_size
+
+    def worker_for(self, task: int) -> int:
+        return self._map[task]
+
+    def tasks_of(self, worker: int) -> List[int]:
+        return sorted(t for t, w in self._map.items() if w == worker)
+
+    @property
+    def tasks(self) -> List[int]:
+        return sorted(self._map)
+
+    def __repr__(self) -> str:
+        return f"LogicalTaskPlan({self._map}, world={self.world_size})"
+
+
+def task_shuffle(tables: Sequence, task_ids: Sequence[int],
+                 plan: LogicalTaskPlan) -> List:
+    """Move each logical table's rows to its task's worker, all tasks in one
+    collective exchange.
+
+    ``tables`` must share a schema.  Returns one table per input task; the
+    rows of output i live entirely on shard ``plan.worker_for(task_ids[i])``
+    (other shards hold zero rows of it), which is the reference's
+    ArrowTaskAllToAll delivery contract.
+    """
+    if len(tables) != len(task_ids):
+        raise CylonError(Code.Invalid, "one task id per table required")
+    if not tables:
+        return []
+    for t in tables[1:]:
+        if t.names != tables[0].names:
+            raise CylonError(Code.Invalid, "task tables must share a schema")
+
+    # tag + concatenate: one combined table with a task-id routing column
+    combined = None
+    for t, task in zip(tables, task_ids):
+        tagged = t.project(list(range(t.column_count)))  # shallow copy
+        tagged[TASK_COL] = np.full((t.row_count,), task, np.int64)
+        combined = tagged if combined is None else combined.merge(tagged)
+
+    shuffled = _plan_shuffle(combined, plan)
+
+    outs = []
+    for task in task_ids:
+        pred = _task_predicate(task)
+        outs.append(shuffled.select(pred).drop([TASK_COL]))
+    return outs
+
+
+_PREDICATES: Dict[int, object] = {}
+
+
+def _task_predicate(task: int):
+    """Stable predicate objects so Table.select's jit cache keys hit."""
+    pred = _PREDICATES.get(task)
+    if pred is None:
+        def pred(env, task=task):
+            return env[TASK_COL] == task
+
+        _PREDICATES[task] = pred
+    return pred
+
+
+def _plan_shuffle(t, plan: LogicalTaskPlan):
+    """Shuffle with plan-lookup routing instead of key hashing (the analog
+    of ArrowTaskAllToAll::insert routing through plan.worker_num_of)."""
+    from ..table import Table
+    from . import ops as par_ops
+    from . import shuffle as shuffle_mod
+
+    world = t.num_shards
+    ctx = t.ctx
+    task_idx = t.names.index(TASK_COL)
+    # dense lookup table task -> worker (tasks may be sparse ids)
+    max_task = max(plan.tasks) if plan.tasks else 0
+    lut = np.zeros((max_task + 2,), np.int32)
+    for task, worker in plan._map.items():
+        lut[task] = worker
+    lut_key = tuple(int(x) for x in lut)
+
+    def targets(tt):
+        count = tt.row_counts[0]
+        cap = tt.columns[0].data.shape[0]
+        task_col = tt.columns[task_idx].data.astype(jnp.int32)
+        tgt = jnp.take(jnp.asarray(np.asarray(lut_key, np.int32)),
+                       jnp.clip(task_col, 0, len(lut_key) - 1))
+        live = jnp.arange(cap, dtype=jnp.int32) < count
+        return jnp.where(live, tgt, world)  # padding rows fall off the end
+
+    def counts_fn(tt):
+        return shuffle_mod.target_counts(targets(tt), world)
+
+    counts = par_ops._shard_map(ctx, counts_fn, ("task_counts", lut_key),
+                                par_ops._shapes_key(t))(t)
+    bucket, out_cap = shuffle_mod.plan_shuffle(
+        np.asarray(counts).reshape(world, world))
+    names = t.names
+
+    def fn(tt):
+        tgt = targets(tt)
+        cols, total = shuffle_mod.shuffle_shard(
+            tt.columns, tt.row_counts[0], tgt, world, bucket, out_cap)
+        return Table(cols, jnp.reshape(total, (1,)), names, ctx)
+
+    return par_ops._shard_map(ctx, fn,
+                              ("task_shuffle", lut_key, bucket, out_cap),
+                              par_ops._shapes_key(t))(t)

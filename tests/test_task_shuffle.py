@@ -1,0 +1,54 @@
+"""Task-multiplexed all-to-all (reference: arrow/arrow_task_all_to_all.h,
+demo at cpp/src/examples/task_test.cpp:33-60 — several logical tasks
+exchange tables over shared worker channels)."""
+import numpy as np
+import pytest
+
+
+def test_logical_task_plan():
+    from cylon_tpu.parallel.task import LogicalTaskPlan
+    from cylon_tpu.status import CylonError
+
+    plan = LogicalTaskPlan({0: 0, 1: 2, 2: 2, 5: 3}, world_size=4)
+    assert plan.worker_for(1) == 2
+    assert plan.tasks_of(2) == [1, 2]
+    assert plan.tasks == [0, 1, 2, 5]
+    with pytest.raises(CylonError):
+        LogicalTaskPlan({0: 7}, world_size=4)
+
+
+def test_task_shuffle_delivery(ctx4, rng):
+    """Each logical table's rows land entirely on its assigned worker, and
+    all tasks move in one collective pass."""
+    from cylon_tpu import Table
+    from cylon_tpu.parallel.task import LogicalTaskPlan, task_shuffle
+
+    plan = LogicalTaskPlan({0: 3, 1: 1, 2: 1}, world_size=4)
+    tables, contents = [], []
+    for i in range(3):
+        data = {"a": rng.integers(0, 100, 50 + 10 * i).astype(np.int64),
+                "b": rng.random(50 + 10 * i)}
+        tables.append(Table.from_pydict(data, ctx=ctx4))
+        contents.append(data)
+
+    outs = task_shuffle(tables, [0, 1, 2], plan)
+    assert len(outs) == 3
+    for i, (out, data) in enumerate(zip(outs, contents)):
+        worker = plan.worker_for(i)
+        counts = np.asarray(out.row_counts)
+        assert counts[worker] == len(data["a"]), (i, counts)
+        assert counts.sum() == len(data["a"])  # nothing anywhere else
+        got = out.to_pandas().sort_values(["a", "b"]).reset_index(drop=True)
+        assert np.array_equal(np.sort(got["a"].to_numpy()),
+                              np.sort(data["a"]))
+
+
+def test_task_shuffle_schema_mismatch(ctx4):
+    from cylon_tpu import Table
+    from cylon_tpu.parallel.task import LogicalTaskPlan, task_shuffle
+    from cylon_tpu.status import CylonError
+
+    t1 = Table.from_pydict({"a": [1, 2]}, ctx=ctx4)
+    t2 = Table.from_pydict({"z": [1, 2]}, ctx=ctx4)
+    with pytest.raises(CylonError):
+        task_shuffle([t1, t2], [0, 1], LogicalTaskPlan({0: 0, 1: 1}, 4))
