@@ -81,11 +81,16 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
               opts: SortOptions | None = None):
     """partition -> all-to-all -> compact; returns a new distributed Table."""
     from ..table import Table
+    from ..utils import span
 
     world = t.num_shards
     ctx = t.ctx
-    counts = _counts_for(t, key_idx, mode, opts)
-    bucket, out_cap = shuffle_mod.plan_shuffle(np.asarray(counts).reshape(world, world))
+    # phase timers mirror the reference's split/shuffle chrono spans
+    # (partition/partition.cpp:29-57, table.cpp:163-175)
+    with span("shuffle.plan"):
+        counts = _counts_for(t, key_idx, mode, opts)
+        bucket, out_cap = shuffle_mod.plan_shuffle(
+            np.asarray(counts).reshape(world, world))
     names = t.names
 
     def fn(tt):
@@ -94,8 +99,10 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
                                                 tgt, world, bucket, out_cap)
         return Table(cols, jnp.reshape(total, (1,)), names, ctx)
 
-    return _shard_map(ctx, fn, ("shuffle", key_idx, mode, opts, bucket, out_cap),
-                      _shapes_key(t))(t)
+    with span("shuffle.exchange"):
+        return _shard_map(ctx, fn,
+                          ("shuffle", key_idx, mode, opts, bucket, out_cap),
+                          _shapes_key(t))(t)
 
 
 def shuffle(t, key_idx: Tuple[int, ...]):

@@ -816,7 +816,20 @@ def _join_output_names(left: Table, right: Table, cfg: JoinConfig) -> Tuple[str,
     return tuple(out_l + out_r)
 
 
+def _cap_round(n: int) -> int:
+    """Round a dynamic row count up to a 3-bit-mantissa capacity (at most 8
+    distinct sizes per octave): tight enough that a count just past a power
+    of two doesn't double every downstream kernel, coarse enough that the
+    jit cache stays warm."""
+    if n <= 16:
+        return 16
+    g = 1 << ((n - 1).bit_length() - 3)
+    return -(-n // g) * g
+
+
 def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
+    from .utils import span
+
     names = _join_output_names(left, right, cfg)
     ctx = left.ctx
     jt = cfg.join_type
@@ -826,9 +839,13 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
                                     b.row_counts[0], cfg.left_on, cfg.right_on, jt)
         return jnp.reshape(c, (1,))
 
-    counts = _shard_wise(ctx, count_fn, left, right,
-                         key=("join_count", cfg.left_on, cfg.right_on, jt))
-    out_cap = _pow2ceil(max(1, int(jnp.max(counts))))
+    # sizing pass + gather pass, the 2-pass Reserve/build of the reference's
+    # join builder (join/join_utils.cpp), with chrono-span parity
+    # (join.cpp:89-253 phase timers)
+    with span("join.count"):
+        counts = _shard_wise(ctx, count_fn, left, right,
+                             key=("join_count", cfg.left_on, cfg.right_on, jt))
+        out_cap = _cap_round(max(1, int(jnp.max(counts))))
 
     def gather_fn(a: Table, b: Table) -> Table:
         cols, m = join_mod.join_gather(a.columns, a.row_counts[0], b.columns,
@@ -836,8 +853,9 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
                                        jt, out_cap)
         return Table(cols, jnp.reshape(m, (1,)), names, ctx)
 
-    return _shard_wise(ctx, gather_fn, left, right,
-                       key=("join", cfg.left_on, cfg.right_on, jt, out_cap))
+    with span("join.gather"):
+        return _shard_wise(ctx, gather_fn, left, right,
+                           key=("join", cfg.left_on, cfg.right_on, jt, out_cap))
 
 
 def _local_set_op(a: Table, b: Table, op: str) -> Table:

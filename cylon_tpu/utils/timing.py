@@ -1,0 +1,60 @@
+"""Phase timing spans — the observability the reference gets from manual
+``std::chrono`` + glog pairs around every hot phase (e.g. join combine/
+sort/final-build timers join/join.cpp:89-253, split timing
+partition/partition.cpp:29-57, shuffle left/right timing table.cpp:163-175,
+CYLON_DEBUG-gated phase timers in Unique, table.cpp:970-1026).
+
+``span("name")`` measures wall time; enabled when the ``CYLON_TPU_DEBUG``
+env var is set (the reference's CYLON_DEBUG build flag) or via
+``enable()``.  Spans always accumulate into a process-local registry that
+``report()`` snapshots, so benchmarks can read phase breakdowns without
+log scraping.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Iterator, Tuple
+
+log = logging.getLogger("cylon_tpu")
+
+_enabled = bool(os.environ.get("CYLON_TPU_DEBUG"))
+_totals: Dict[str, float] = defaultdict(float)
+_counts: Dict[str, int] = defaultdict(int)
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+@contextmanager
+def span(name: str) -> Iterator[None]:
+    """Wall-time span; logs at INFO when debug timing is on and always
+    accumulates into the registry."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _totals[name] += dt
+        _counts[name] += 1
+        if _enabled:
+            log.info("%s took %.3f ms", name, dt * 1e3)
+
+
+def report() -> Dict[str, Tuple[float, int]]:
+    """{span name: (total seconds, call count)} snapshot."""
+    return {k: (_totals[k], _counts[k]) for k in _totals}
+
+
+def reset() -> None:
+    _totals.clear()
+    _counts.clear()

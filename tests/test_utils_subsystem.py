@@ -1,0 +1,59 @@
+"""utils/ subsystem: timing spans, bench decorator, uuid, to_string
+(reference: util/uuid.cpp, util/to_string.hpp, pycylon util/benchutils.py,
+the CYLON_DEBUG chrono spans)."""
+import re
+
+
+def test_uuid_v4():
+    from cylon_tpu.utils import generate_uuid_v4
+
+    u = generate_uuid_v4()
+    assert re.fullmatch(r"[0-9a-f]{8}-[0-9a-f]{4}-4[0-9a-f]{3}-[89ab][0-9a-f]{3}-[0-9a-f]{12}", u)
+    assert generate_uuid_v4() != u
+
+
+def test_to_string():
+    from cylon_tpu.utils import to_string
+
+    assert to_string(None) == ""
+    assert to_string(True) == "true"
+    assert to_string(3) == "3"
+    assert to_string("x", quote_strings=True) == '"x"'
+    assert to_string(b"ab") == "ab"
+
+
+def test_timing_spans():
+    from cylon_tpu.utils import span, timing_report, timing_reset
+
+    timing_reset()
+    with span("phase.a"):
+        pass
+    with span("phase.a"):
+        pass
+    total, count = timing_report()["phase.a"]
+    assert count == 2 and total >= 0
+
+
+def test_benchmark_decorator():
+    from cylon_tpu.utils import benchmark_with_repetitions, time_conversion
+
+    @benchmark_with_repetitions(repetitions=3, time_type="us")
+    def f(x):
+        return x + 1
+
+    avg_us, result = f(41)
+    assert result == 42 and avg_us >= 0
+    assert time_conversion(1e6, "ms") == 1.0
+
+
+def test_join_emits_spans(local_ctx):
+    import numpy as np
+    from cylon_tpu import Table
+    from cylon_tpu.utils import timing_report, timing_reset
+
+    timing_reset()
+    t = Table.from_pydict({"k": np.arange(50) % 7, "v": np.arange(50.0)},
+                          ctx=local_ctx)
+    t.join(t, on="k")
+    rep = timing_report()
+    assert "join.count" in rep and "join.gather" in rep
