@@ -1,0 +1,150 @@
+"""Pallas TPU kernels for the hot partition path.
+
+The reference's hottest per-row loop is the partition hash: scalar
+MurmurHash3_x86_32 per value, ``31*h + x`` across columns, modulo world
+(cpp/src/cylon/arrow/arrow_partition_kernels.hpp:93-233 HashPartitionKernel
+::UpdateHash/Partition, util/murmur3.cpp).  Here it is one fused VMEM-
+resident Pallas kernel: every lane hashes one row through the murmur3 block
+recurrence (unrolled over the static word count), combines columns, and
+emits the target shard — one HBM read per word buffer, one write, zero
+intermediates.
+
+Bit-exactness: a row's device hash equals the native layer's
+``ct_row_hash`` (cylon_tpu/native/src/hashing.cpp) for fixed-width
+columns — both compute murmur3_x86_32 over the value's little-endian bytes
+with seed 0, combined as ``h = 31*h + column_hash`` from ``h = 1`` — so
+host-partitioned and device-partitioned rows land on the same shard.
+
+The kernel runs natively on TPU; elsewhere ``pallas_call`` uses interpret
+mode (tests) or callers fall back to the jnp path in ops/hashing.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..column import Column
+
+_LANES = 128
+_MIN_ROWS = 8 * _LANES  # one (8, 128) uint32 tile
+
+C1 = 0xCC9E2D51
+C2 = 0x1B873593
+
+
+def _rotl(x: jax.Array, r: int) -> jax.Array:
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def _murmur3_words(words: Sequence[jax.Array], seed: int = 0) -> jax.Array:
+    """murmur3_x86_32 of the little-endian concatenation of 4-byte words,
+    vectorized over lanes (reference: util/murmur3.cpp MurmurHash3_x86_32,
+    whole-block path; no tail since input is word-aligned)."""
+    h = jnp.full(words[0].shape, seed, jnp.uint32)
+    for w in words:
+        k = w.astype(jnp.uint32) * jnp.uint32(C1)
+        k = _rotl(k, 15)
+        k = k * jnp.uint32(C2)
+        h = h ^ k
+        h = _rotl(h, 13)
+        h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    h = h ^ jnp.uint32(4 * len(words))
+    h ^= h >> 16
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    return h
+
+
+def column_words(col: Column) -> List[jax.Array]:
+    """uint32 word columns (little-endian order) for a fixed-width column;
+    the unit the native hasher consumes byte-wise."""
+    data = col.data
+    if col.is_string:
+        raise ValueError("string columns use the jnp hash path")
+    if data.dtype == jnp.bool_:
+        return [data.astype(jnp.uint32)]
+    size = data.dtype.itemsize
+    if size <= 4:
+        bits = jax.lax.bitcast_convert_type(
+            data, {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}[size])
+        return [bits.astype(jnp.uint32)]
+    bits = jax.lax.bitcast_convert_type(data, jnp.uint64)
+    lo = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (bits >> jnp.uint64(32)).astype(jnp.uint32)
+    return [lo, hi]
+
+
+def _hash_kernel(nwords: Tuple[int, ...], world: int, *refs):
+    """Kernel body: refs = flattened word refs per column + (hash_out,
+    target_out)."""
+    word_refs, (h_out, t_out) = refs[:-2], refs[-2:]
+    h = jnp.full(word_refs[0].shape, 1, jnp.uint32)  # native row_hash seed
+    i = 0
+    for n in nwords:
+        col_words = [word_refs[i + k][:] for k in range(n)]
+        i += n
+        h = h * jnp.uint32(31) + _murmur3_words(col_words)
+    h_out[:] = h
+    if world & (world - 1) == 0:
+        t_out[:] = (h & jnp.uint32(world - 1)).astype(jnp.int32)
+    else:
+        t_out[:] = (h % jnp.uint32(world)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("nwords", "world", "interpret"))
+def _hash_partition_padded(flat_words, nwords: Tuple[int, ...], world: int,
+                           interpret: bool):
+    n = flat_words[0].shape[0]
+    rows = n // _LANES
+    block_rows = min(rows, 256)
+    grid = (rows // block_rows,)
+    spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    shaped = [w.reshape(rows, _LANES) for w in flat_words]
+    h, t = pl.pallas_call(
+        functools.partial(_hash_kernel, nwords, world),
+        grid=grid,
+        in_specs=[spec] * len(shaped),
+        out_specs=(spec, spec),
+        out_shape=(jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32),
+                   jax.ShapeDtypeStruct((rows, _LANES), jnp.int32)),
+        interpret=interpret,
+    )(*shaped)
+    return h.reshape(n), t.reshape(n)
+
+
+def supported(cols: Sequence[Column]) -> bool:
+    return all(not c.is_string for c in cols)
+
+
+def hash_partition(cols: Sequence[Column], world: int,
+                   interpret: bool | None = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """(hashes uint32[cap], targets int32[cap]) for fixed-width key columns
+    via the fused Pallas kernel; pads rows to a whole tile and slices back.
+    Padding-row targets are whatever the hash of zero bytes lands on —
+    callers mask them (partition.hash_targets does)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cap = cols[0].data.shape[0]
+    flat: List[jax.Array] = []
+    nwords: List[int] = []
+    for c in cols:
+        ws = column_words(c)
+        # null rows hash as zero bytes so equal-null rows collide onto one
+        # shard (the jnp path uses a sentinel for the same purpose)
+        ws = [jnp.where(c.validity, w, 0) for w in ws]
+        nwords.append(len(ws))
+        flat.extend(ws)
+    pad = (-cap) % _MIN_ROWS
+    if pad:
+        flat = [jnp.concatenate([w, jnp.zeros((pad,), jnp.uint32)])
+                for w in flat]
+    h, t = _hash_partition_padded(tuple(flat), tuple(nwords), world,
+                                  interpret)
+    return h[:cap], t[:cap]

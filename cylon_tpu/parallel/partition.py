@@ -25,18 +25,28 @@ import jax.numpy as jnp
 from ..column import Column
 from ..ops import compact as compact_mod
 from ..ops import hashing
+from ..ops import pallas_kernels
 from . import collectives
 
 
 def hash_targets(cols: Sequence[Column], count, key_idx: Sequence[int],
                  world: int) -> jax.Array:
-    """int32[cap] target shard per row (``world`` for padding rows)."""
+    """int32[cap] target shard per row (``world`` for padding rows).
+
+    On TPU, fixed-width keys route through the fused Pallas murmur3 kernel
+    (ops/pallas_kernels.hash_partition — bit-identical to the native host
+    hasher, so host- and device-partitioned rows agree); string keys and
+    CPU execution use the vectorized jnp hash."""
     cap = cols[0].data.shape[0]
-    h = hashing.hash_columns([cols[i] for i in key_idx])
-    if world & (world - 1) == 0:
-        t = (h & jnp.uint32(world - 1)).astype(jnp.int32)
+    key_cols = [cols[i] for i in key_idx]
+    if jax.default_backend() == "tpu" and pallas_kernels.supported(key_cols):
+        _, t = pallas_kernels.hash_partition(key_cols, world)
     else:
-        t = (h % jnp.uint32(world)).astype(jnp.int32)
+        h = hashing.hash_columns(key_cols)
+        if world & (world - 1) == 0:
+            t = (h & jnp.uint32(world - 1)).astype(jnp.int32)
+        else:
+            t = (h % jnp.uint32(world)).astype(jnp.int32)
     live = compact_mod.live_mask(cap, count)
     return jnp.where(live, t, jnp.int32(world))
 

@@ -1,0 +1,68 @@
+"""Pallas hash/partition kernel (interpret mode on the CPU test mesh).
+
+The kernel must be bit-identical to the native C++ murmur3 row hasher
+(cylon_tpu/native/src/hashing.cpp ct_row_hash) so host- and device-
+partitioned rows land on the same shard.
+"""
+import numpy as np
+import pytest
+
+from cylon_tpu import column as colmod
+from cylon_tpu import native
+from cylon_tpu.ops import pallas_kernels
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason=f"native: {native.load_error()}")
+
+
+def _pallas_hash(np_arrays, world=4):
+    cols = [colmod.from_numpy(a) for a in np_arrays]
+    h, t = pallas_kernels.hash_partition(cols, world, interpret=True)
+    n = len(np_arrays[0])
+    return np.asarray(h)[:n], np.asarray(t)[:n]
+
+
+@needs_native
+@pytest.mark.parametrize("dtype", [np.int32, np.uint32, np.float32])
+def test_matches_native_murmur3_4byte(dtype, rng):
+    vals = rng.integers(0, 1 << 30, 200).astype(dtype)
+    h, t = _pallas_hash([vals])
+    expect = native.row_hash([vals])
+    assert np.array_equal(h, expect)
+    assert np.array_equal(t, expect % 4)
+
+
+@needs_native
+@pytest.mark.parametrize("dtype", [np.int64, np.float64])
+def test_matches_native_murmur3_8byte(dtype, rng):
+    vals = rng.integers(1, 1 << 40, 150).astype(dtype)
+    h, _ = _pallas_hash([vals])
+    assert np.array_equal(h, native.row_hash([vals]))
+
+
+@needs_native
+def test_matches_native_multi_column(rng):
+    a = rng.integers(0, 1000, 100).astype(np.int32)
+    b = rng.random(100)
+    h, _ = _pallas_hash([a, b])
+    assert np.array_equal(h, native.row_hash([a, b]))
+
+
+def test_null_rows_collide(rng):
+    from cylon_tpu.column import Column
+    import jax.numpy as jnp
+
+    vals = rng.integers(0, 100, 64).astype(np.int32)
+    validity = np.ones(64, bool)
+    validity[[3, 17]] = False
+    col = colmod.from_numpy(vals)
+    col = Column(col.data, jnp.asarray(validity), None, col.dtype)
+    h, _ = pallas_kernels.hash_partition([col], 4, interpret=True)
+    h = np.asarray(h)[:64]
+    assert h[3] == h[17]  # equal nulls, equal shard
+
+
+def test_padding_sliced_off(rng):
+    vals = rng.integers(0, 10, 17).astype(np.int32)  # far below one tile
+    h, t = _pallas_hash([vals])
+    assert h.shape == (17,) and t.shape == (17,)
