@@ -303,16 +303,18 @@ def exchange_bytes(ctx, per_target: Sequence[np.ndarray]) -> List[np.ndarray]:
     world = ctx.GetWorldSize()
     if len(per_target) != world:
         raise CylonError(Code.Invalid, "need one send list per rank")
-    maxlen = max((len(b) for row in per_target for b in row), default=0)
+    raws = [[(np.frombuffer(bytes(b), np.uint8)
+              if not isinstance(b, np.ndarray)
+              else np.ascontiguousarray(b).view(np.uint8).ravel())
+             for b in row] for row in per_target]
+    maxlen = max((r.size for row in raws for r in row), default=0)
     maxlen = max(maxlen, 1)
     sendbuf = np.zeros((world, world, maxlen), np.uint8)
     lengths = np.zeros((world, world), np.int32)
-    for r, row in enumerate(per_target):
-        for t, b in enumerate(row):
-            raw = np.frombuffer(bytes(b), np.uint8) if not isinstance(
-                b, np.ndarray) else b.view(np.uint8).ravel()
-            sendbuf[r, t, :len(raw)] = raw
-            lengths[r, t] = len(raw)
+    for r, row in enumerate(raws):
+        for t, raw in enumerate(row):
+            sendbuf[r, t, :raw.size] = raw
+            lengths[r, t] = raw.size
 
     def fn(chunk, lens):
         return (collectives.all_to_all(chunk[0]),

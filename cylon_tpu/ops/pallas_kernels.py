@@ -31,6 +31,7 @@ from ..column import Column
 
 _LANES = 128
 _MIN_ROWS = 8 * _LANES  # one (8, 128) uint32 tile
+_BLOCK_ROWS = 256       # max row-tiles per grid block
 
 C1 = 0xCC9E2D51
 C2 = 0x1B873593
@@ -102,13 +103,14 @@ def _hash_partition_padded(flat_words, nwords: Tuple[int, ...], world: int,
                            interpret: bool):
     n = flat_words[0].shape[0]
     rows = n // _LANES
-    block_rows = min(rows, 256)
-    grid = (rows // block_rows,)
+    block_rows = min(rows, _BLOCK_ROWS)
+    if rows % block_rows:  # caller pads to a whole number of grid blocks
+        raise ValueError(f"rows {rows} not a multiple of block {block_rows}")
     spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
     shaped = [w.reshape(rows, _LANES) for w in flat_words]
     h, t = pl.pallas_call(
         functools.partial(_hash_kernel, nwords, world),
-        grid=grid,
+        grid=(rows // block_rows,),
         in_specs=[spec] * len(shaped),
         out_specs=(spec, spec),
         out_shape=(jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32),
@@ -132,6 +134,8 @@ def hash_partition(cols: Sequence[Column], world: int,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     cap = cols[0].data.shape[0]
+    if cap == 0:
+        return jnp.zeros((0,), jnp.uint32), jnp.zeros((0,), jnp.int32)
     flat: List[jax.Array] = []
     nwords: List[int] = []
     for c in cols:
@@ -141,7 +145,14 @@ def hash_partition(cols: Sequence[Column], world: int,
         ws = [jnp.where(c.validity, w, 0) for w in ws]
         nwords.append(len(ws))
         flat.extend(ws)
-    pad = (-cap) % _MIN_ROWS
+    # one eager pad up to a whole grid of full-size blocks: a floor-divided
+    # grid would skip tail tiles and leave their hashes undefined, while
+    # full blocks keep every grid step saturated (waste <= one block,
+    # ~32K elements — negligible hash work)
+    tiles = -(-cap // _MIN_ROWS) * 8          # whole (8,128) tile groups
+    block = min(tiles, _BLOCK_ROWS)
+    tiles = -(-tiles // block) * block        # whole grid blocks
+    pad = tiles * _LANES - cap
     if pad:
         flat = [jnp.concatenate([w, jnp.zeros((pad,), jnp.uint32)])
                 for w in flat]

@@ -64,6 +64,10 @@ def task_shuffle(tables: Sequence, task_ids: Sequence[int],
     """
     if len(tables) != len(task_ids):
         raise CylonError(Code.Invalid, "one task id per table required")
+    unplanned = sorted(set(task_ids) - set(plan.tasks))
+    if unplanned:
+        raise CylonError(Code.Invalid,
+                         f"task ids not in plan: {unplanned}")
     if not tables:
         return []
     for t in tables[1:]:

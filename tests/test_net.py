@@ -100,3 +100,21 @@ def test_exchange_bytes_device(ctx4):
     for r in range(world):
         for s in range(world):
             assert bytes(received[r][s]) == f"{s}:{r}".encode() * (r + 1)
+
+
+def test_exchange_bytes_ndarray_views(ctx4):
+    """Non-uint8 and non-contiguous ndarray buffers serialize by nbytes."""
+    import numpy as np
+
+    from cylon_tpu.net import exchange_bytes
+
+    world = 4
+    base = np.arange(40, dtype=np.int32).reshape(5, 8)
+    per_target = [[base[:, ::2][: r + 1] for t in range(world)]
+                  for r in range(world)]
+    received = exchange_bytes(ctx4, per_target)
+    for r in range(world):
+        for s in range(world):
+            expect = np.ascontiguousarray(base[:, ::2][: s + 1])
+            got = np.frombuffer(bytes(received[r][s]), np.int32)
+            assert np.array_equal(got, expect.ravel())

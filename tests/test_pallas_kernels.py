@@ -66,3 +66,51 @@ def test_padding_sliced_off(rng):
     vals = rng.integers(0, 10, 17).astype(np.int32)  # far below one tile
     h, t = _pallas_hash([vals])
     assert h.shape == (17,) and t.shape == (17,)
+
+
+@needs_native
+def test_multi_block_grid_covers_tail(rng):
+    # 33000 rows -> 264 row-tiles, not a multiple of the 256-tile block:
+    # must pad to a 2-block grid (512 tiles) or the tail tiles' hashes
+    # are undefined (the round-1 truncation bug).
+    n = 33000
+    vals = rng.integers(0, 1 << 30, n).astype(np.int32)
+    h, t = _pallas_hash([vals])
+    expect = native.row_hash([vals])
+    assert np.array_equal(h, expect)
+    assert np.array_equal(t, expect % 4)
+
+
+def test_multi_block_matches_single_block(rng):
+    # native-independent truncation guard: hashes from a multi-block grid
+    # must equal hashes of the same prefix run through a one-block grid.
+    n = 33000
+    vals = rng.integers(0, 1 << 30, n).astype(np.int32)
+    h_big, _ = _pallas_hash([vals])
+    h_small, _ = _pallas_hash([vals[-1000:]])
+    assert np.array_equal(h_big[-1000:], h_small)
+
+
+@needs_native
+def test_multi_block_exact_multiple(rng):
+    # 256 tiles exactly (32768 rows): grid of 1 full block, no padding.
+    n = 256 * 128
+    vals = rng.integers(0, 1 << 30, n).astype(np.uint32)
+    h, _ = _pallas_hash([vals])
+    assert np.array_equal(h, native.row_hash([vals]))
+
+
+@needs_native
+def test_prime_tile_count(rng):
+    # 37888 rows -> 296 row-tiles = 8*37 (37 prime): pads to two full
+    # 256-tile blocks; every tail row must still hash correctly.
+    n = 37 * 1024
+    vals = rng.integers(0, 1 << 30, n).astype(np.int32)
+    h, _ = _pallas_hash([vals])
+    assert np.array_equal(h, native.row_hash([vals]))
+
+
+def test_empty_column():
+    vals = np.zeros((0,), np.int32)
+    h, t = _pallas_hash([vals])
+    assert h.shape == (0,) and t.shape == (0,)
