@@ -16,6 +16,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import precision
 from ..column import Column
 from . import compact
 
@@ -37,19 +38,19 @@ def scalar_agg(col: Column, count, op: ReduceOp):
     if col.is_string and op not in (ReduceOp.COUNT,):
         raise TypeError("scalar aggregation unsupported on string columns")
     mask = col.validity & compact.live_mask(cap, count)
-    n = jnp.sum(mask, dtype=jnp.int64)
+    n = jnp.sum(mask, dtype=precision.count_acc())
+    n = n if precision.narrow() else n.astype(jnp.int64)
     if op == ReduceOp.COUNT:
         return n, n
     data = col.data
     if data.dtype == jnp.bool_:
-        data = data.astype(jnp.int64)
-    if op == ReduceOp.SUM:
-        acc = data.astype(jnp.float64 if jnp.issubdtype(data.dtype, jnp.floating)
-                          else jnp.int64)
-        return jnp.sum(jnp.where(mask, acc, 0)), n
-    if op == ReduceOp.PROD:
-        acc = data.astype(jnp.float64 if jnp.issubdtype(data.dtype, jnp.floating)
-                          else jnp.int64)
+        data = data.astype(jnp.int32)
+    if op in (ReduceOp.SUM, ReduceOp.PROD):
+        acc = data.astype(precision.float_acc()
+                          if jnp.issubdtype(data.dtype, jnp.floating)
+                          else precision.int_acc())
+        if op == ReduceOp.SUM:
+            return jnp.sum(jnp.where(mask, acc, 0)), n
         return jnp.prod(jnp.where(mask, acc, 1)), n
     if jnp.issubdtype(data.dtype, jnp.floating):
         lo, hi = -jnp.inf, jnp.inf

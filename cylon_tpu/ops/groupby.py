@@ -26,7 +26,7 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .. import dtypes
+from .. import dtypes, precision
 from ..column import Column
 from . import keys, segments
 
@@ -43,6 +43,7 @@ class AggOp(enum.IntEnum):
     STDDEV = 6
     NUNIQUE = 7
     SUMSQ = 8  # internal: sum of squares partial for VAR/STDDEV two-phase
+    COUNTSUM = 9  # internal: sum of partial counts — i32 scatter in narrow
 
     @staticmethod
     def of(name: "str | AggOp") -> "AggOp":
@@ -73,19 +74,26 @@ def partial_ops(op: AggOp) -> Tuple[AggOp, ...]:
 
 def combine_op(partial: AggOp) -> AggOp:
     """How a partial column recombines in the final phase."""
-    if partial in (AggOp.SUM, AggOp.COUNT, AggOp.SUMSQ):
+    if partial == AggOp.COUNT:
+        # counts are bounded by rows, so the combine keeps the count
+        # accumulator (i32 in narrow mode) instead of the int-SUM i64 path
+        return AggOp.COUNTSUM
+    if partial in (AggOp.SUM, AggOp.SUMSQ):
         return AggOp.SUM
     return partial  # MIN of mins, MAX of maxes
 
 
 def _agg_out_dtype(op: AggOp, dt: dtypes.DataType):
-    if op in (AggOp.COUNT, AggOp.NUNIQUE):
-        return dtypes.int64
+    nar = precision.narrow()
+    if op in (AggOp.COUNT, AggOp.NUNIQUE, AggOp.COUNTSUM):
+        return dtypes.int32 if nar else dtypes.int64
     if op in (AggOp.MEAN, AggOp.VAR, AggOp.STDDEV, AggOp.SUMSQ):
-        return dtypes.double
+        return dtypes.float_ if nar else dtypes.double
     if op == AggOp.SUM:
         if dtypes.is_floating(dt):
-            return dtypes.double if dt.type == dtypes.Type.DOUBLE else dtypes.float_
+            if dt.type == dtypes.Type.DOUBLE and not nar:
+                return dtypes.double
+            return dtypes.float_
         return dtypes.int64
     return dt  # MIN/MAX keep the input type
 
@@ -104,19 +112,22 @@ def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
     accumulation (MEAN/VAR/STDDEV/SUMSQ, f64/int64 SUM) pay the 64-bit
     scatter."""
     cnt32 = jax.ops.segment_sum(valid.astype(jnp.int32), gid, num_segments)
-    cnt = cnt32.astype(jnp.int64)
+    cnt = cnt32 if precision.narrow() else cnt32.astype(jnp.int64)
     if op == AggOp.COUNT:
         return cnt, cnt
+    if op == AggOp.COUNTSUM:
+        x = jnp.where(valid, data, 0).astype(precision.count_acc())
+        s = jax.ops.segment_sum(x, gid, num_segments)
+        return (s if precision.narrow() else s.astype(jnp.int64)), cnt
     if op == AggOp.SUMSQ:
-        x = jnp.where(valid, data, 0).astype(jnp.float64)
+        x = jnp.where(valid, data, 0).astype(precision.float_acc())
         return jax.ops.segment_sum(x * x, gid, num_segments), cnt
     if op == AggOp.SUM:
         acc = jnp.where(valid, data, jnp.zeros((), data.dtype))
         if jnp.issubdtype(data.dtype, jnp.floating):
-            acc = acc.astype(jnp.float64 if data.dtype == jnp.float64
-                             else jnp.float32)
+            acc = acc.astype(precision.float_acc_for(data.dtype))
         else:
-            acc = acc.astype(jnp.int64)
+            acc = acc.astype(precision.int_acc())
         return jax.ops.segment_sum(acc, gid, num_segments), cnt
     if op == AggOp.MIN or op == AggOp.MAX:
         if jnp.issubdtype(data.dtype, jnp.floating):
@@ -132,12 +143,13 @@ def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
         out = f(masked, gid, num_segments)
         return jnp.where(cnt > 0, out, jnp.zeros((), out.dtype)), cnt
     if op in (AggOp.MEAN, AggOp.VAR, AggOp.STDDEV):
-        x = jnp.where(valid, data, 0).astype(jnp.float64)
+        facc = precision.float_acc()
+        x = jnp.where(valid, data, 0).astype(facc)
         s = jax.ops.segment_sum(x, gid, num_segments)
         if op == AggOp.MEAN:
-            return s / jnp.maximum(cnt, 1), cnt
+            return s / jnp.maximum(cnt, 1).astype(facc), cnt
         s2 = jax.ops.segment_sum(x * x, gid, num_segments)
-        n = jnp.maximum(cnt, 1).astype(jnp.float64)
+        n = jnp.maximum(cnt, 1).astype(facc)
         var = (s2 - s * s / n) / jnp.maximum(n - ddof, 1.0)
         var = jnp.maximum(var, 0.0)
         if op == AggOp.STDDEV:
@@ -209,7 +221,7 @@ def _nunique(vcol: Column, vvalid, gid, cap: int):
     new_distinct = (~eq) & svalid
     # i32 scatter-add, widened after: 64-bit scatters are ~8x slower on TPU
     cnt = jax.ops.segment_sum(new_distinct.astype(jnp.int32), gsorted, cap)
-    return cnt.astype(jnp.int64), cnt
+    return (cnt if precision.narrow() else cnt.astype(jnp.int64)), cnt
 
 
 @partial(jax.jit, static_argnames=("key_idx", "aggs", "ddof"))

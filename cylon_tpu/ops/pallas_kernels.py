@@ -132,7 +132,8 @@ def hash_partition(cols: Sequence[Column], world: int,
     Padding-row targets are whatever the hash of zero bytes lands on —
     callers mask them (partition.hash_targets does)."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from .. import precision
+        interpret = not precision.on_tpu()
     cap = cols[0].data.shape[0]
     if cap == 0:
         return jnp.zeros((0,), jnp.uint32), jnp.zeros((0,), jnp.int32)

@@ -26,6 +26,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import precision
 from . import compact
 
 
@@ -52,16 +53,17 @@ def _span_take(csum0: jax.Array, pos: jax.Array) -> jax.Array:
 def segment_sum_sorted(x: jax.Array, start: jax.Array, end: jax.Array,
                        acc_dtype=None) -> jax.Array:
     """Segment sums via prefix sum + boundary gather.  ``x`` must already be
-    masked (padding/null rows zeroed).  ``acc_dtype`` defaults to a wide
-    accumulator (f64 for floats, i64 for ints) — the prefix sum over the
-    whole column needs the headroom even when per-segment sums are small."""
+    masked (padding/null rows zeroed).  ``acc_dtype`` defaults to the
+    precision policy's accumulator (f64/i64 wide, f32/i64 narrow) — the
+    prefix sum over the whole column needs the headroom even when
+    per-segment sums are small."""
     if acc_dtype is None:
         if jnp.issubdtype(x.dtype, jnp.floating):
-            acc_dtype = jnp.float64
+            acc_dtype = precision.float_acc()
         elif x.dtype == jnp.bool_:
             acc_dtype = jnp.int32
         else:
-            acc_dtype = jnp.int64
+            acc_dtype = precision.int_acc()
     csum = jnp.cumsum(x.astype(acc_dtype))
     csum0 = jnp.concatenate([jnp.zeros((1,), acc_dtype), csum])
     return _span_take(csum0, end) - _span_take(csum0, start)

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import dtypes
+from .. import dtypes, precision
 from ..column import Column
 from ..config import SortOptions
 from ..context import PARTITION_AXIS, CylonContext
@@ -223,26 +223,28 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
         def pcol(pop):
             return fcols[nkeys + partial_index[(ci, pop)]]
 
+        facc = precision.float_acc()
+        fdt = dtypes.float_ if precision.narrow() else dtypes.double
         if op in (AggOp.SUM, AggOp.MIN, AggOp.MAX, AggOp.COUNT):
             out_cols.append(pcol(op))
         elif op == AggOp.MEAN:
             s, c = pcol(AggOp.SUM), pcol(AggOp.COUNT)
-            cnt = jnp.maximum(c.data, 1).astype(jnp.float64)
-            v = s.data.astype(jnp.float64) / cnt
+            cnt = jnp.maximum(c.data, 1).astype(facc)
+            v = s.data.astype(facc) / cnt
             valid = s.validity & (c.data > 0)
             out_cols.append(Column(jnp.where(valid, v, 0.0), valid, None,
-                                   dtypes.double))
+                                   fdt))
         elif op in (AggOp.VAR, AggOp.STDDEV):
             s, c, s2 = pcol(AggOp.SUM), pcol(AggOp.COUNT), pcol(AggOp.SUMSQ)
-            n = jnp.maximum(c.data, 1).astype(jnp.float64)
-            var = (s2.data - s.data.astype(jnp.float64) ** 2 / n) / jnp.maximum(
+            n = jnp.maximum(c.data, 1).astype(facc)
+            var = (s2.data - s.data.astype(facc) ** 2 / n) / jnp.maximum(
                 n - ddof, 1.0)
             var = jnp.maximum(var, 0.0)
             if op == AggOp.STDDEV:
                 var = jnp.sqrt(var)
             valid = s.validity & ((c.data - ddof) > 0)
             out_cols.append(Column(jnp.where(valid, var, 0.0), valid, None,
-                                   dtypes.double))
+                                   fdt))
         else:
             raise NotImplementedError(op)
     return Table(tuple(out_cols), fcounts, names_out, ctx)

@@ -22,6 +22,7 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import precision
 from ..column import Column
 from ..ops import compact as compact_mod
 from ..ops import hashing
@@ -39,7 +40,7 @@ def hash_targets(cols: Sequence[Column], count, key_idx: Sequence[int],
     CPU execution use the vectorized jnp hash."""
     cap = cols[0].data.shape[0]
     key_cols = [cols[i] for i in key_idx]
-    if jax.default_backend() == "tpu" and pallas_kernels.supported(key_cols):
+    if precision.on_tpu() and pallas_kernels.supported(key_cols):
         _, t = pallas_kernels.hash_partition(key_cols, world)
     else:
         h = hashing.hash_columns(key_cols)
@@ -65,18 +66,21 @@ def range_targets(col: Column, count, world: int, *, num_bins: int,
     data = col.data
     if data.dtype == jnp.bool_:
         data = data.astype(jnp.int32)
-    fdata = data.astype(jnp.float64)
+    # bin math precision only shapes load balance, never correctness: the
+    # value->bin map stays monotone under any float rounding
+    facc = precision.float_acc()
+    fdata = data.astype(facc)
 
-    big = jnp.asarray(jnp.finfo(jnp.float64).max, jnp.float64)
+    big = jnp.asarray(jnp.finfo(facc).max, facc)
     gmin = collectives.allreduce_min(jnp.min(jnp.where(live, fdata, big)))
     gmax = collectives.allreduce_max(jnp.max(jnp.where(live, fdata, -big)))
-    span = jnp.maximum(gmax - gmin, 1e-300)
+    span = jnp.maximum(gmax - gmin, jnp.asarray(jnp.finfo(facc).tiny, facc))
 
     # deterministic stride sample of live rows (reference samples `num_samples`
     # values per worker, partition.cpp:181)
     n_live = jnp.sum(live, dtype=jnp.int32)
-    pos = (jnp.arange(num_samples, dtype=jnp.float64)
-           * jnp.maximum(n_live, 1).astype(jnp.float64) / num_samples)
+    pos = (jnp.arange(num_samples, dtype=facc)
+           * jnp.maximum(n_live, 1).astype(facc) / num_samples)
     pos = jnp.clip(pos.astype(jnp.int32), 0, cap - 1)
     # live rows are not contiguous post-filter; sample from a compacted view
     perm, m = compact_mod.compact_indices(live)
@@ -86,13 +90,13 @@ def range_targets(col: Column, count, world: int, *, num_bins: int,
 
     sbin = jnp.clip(((sample - gmin) / span * num_bins).astype(jnp.int32),
                     0, num_bins - 1)
-    hist = jax.ops.segment_sum(sample_ok.astype(jnp.int64), sbin, num_bins)
+    hist = jax.ops.segment_sum(sample_ok.astype(jnp.int32), sbin, num_bins)
     hist = collectives.allreduce_sum(hist)          # global histogram (psum)
     total = jnp.maximum(jnp.sum(hist), 1)
 
     # monotone bin -> partition map from the histogram mass midpoint
     cum = jnp.cumsum(hist)
-    mid = (cum - hist / 2).astype(jnp.float64)
+    mid = cum.astype(facc) - hist.astype(facc) / 2
     bin_part = jnp.clip((mid * world / total).astype(jnp.int32), 0, world - 1)
     if not ascending:
         bin_part = (world - 1) - bin_part
