@@ -255,6 +255,9 @@ def to_numpy(col: Column, row_count: int):
                 out[i] = b
         return out
     vals = np.asarray(col.data[:n])
+    ndt = col.dtype.numpy_dtype()
+    if vals.dtype != ndt and vals.dtype.kind in "iu" and np.dtype(ndt).kind in "iu":
+        vals = vals.astype(ndt)  # narrow-mode count buffers widen at export
     if valid.all():
         return vals
     out = vals.astype(object)

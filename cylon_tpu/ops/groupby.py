@@ -86,7 +86,9 @@ def combine_op(partial: AggOp) -> AggOp:
 def _agg_out_dtype(op: AggOp, dt: dtypes.DataType):
     nar = precision.narrow()
     if op in (AggOp.COUNT, AggOp.NUNIQUE, AggOp.COUNTSUM):
-        return dtypes.int32 if nar else dtypes.int64
+        # declared int64 even in narrow mode: the device buffer stays i32
+        # (cheap scatter) and widens at the host/arrow column boundary
+        return dtypes.int64
     if op in (AggOp.MEAN, AggOp.VAR, AggOp.STDDEV, AggOp.SUMSQ):
         return dtypes.float_ if nar else dtypes.double
     if op == AggOp.SUM:
