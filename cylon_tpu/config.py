@@ -24,10 +24,11 @@ class JoinType(enum.IntEnum):
 class JoinAlgorithm(enum.IntEnum):
     """reference: join/join_config.hpp JoinAlgorithm {SORT, HASH}.
 
-    On TPU both map to the fused sort-merge kernel today (sort is the
-    hardware-native strategy; a Pallas hash-table probe is the planned HASH
-    specialization), so the enum is honored for API parity and algorithm
-    selection is a hint.
+    Two genuinely distinct kernel families, like the reference's
+    do_(inplace_)sorted_join vs do_hash_join (join.cpp:515-543): SORT is
+    the fused combined-lexsort merge (ops/join.py), HASH the
+    open-addressing build/probe over a device hash table
+    (ops/hash_join.py) that never sorts the probe side.
     """
 
     SORT = 0

@@ -1,0 +1,178 @@
+"""Hash-join kernel: open-addressing build + probe.
+
+A real HASH algorithm family distinct from the sort-merge kernel
+(reference: ``do_hash_join`` cpp/src/cylon/join/join.cpp:448-513 and
+``HashJoinKernel`` arrow/arrow_hash_kernels.hpp:33-215 — multimap build on
+one side, probe from the other), shaped for XLA instead of pointers:
+
+- the hash table is an ``int32[slots]`` array of build-row ids (open
+  addressing, linear probing) built by a ``lax.while_loop`` whose body is a
+  vectorized claim round: every unplaced build row tries to claim its
+  probe slot with one ``scatter-min`` (lowest row id wins a contended
+  empty slot — deterministic), duplicates chain to the winning owner by
+  key equality, losers advance their probe offset.  Expected rounds are
+  O(1) at 0.5 load factor; total-duplicate inputs finish in 2 rounds
+  (one claim, one chain).
+- probe is the same loop shape per probe row: gather the slot, stop on
+  empty (no match) or key-equal owner (match), else step.
+- multiplicity (a probe row matching k build rows) reuses the sort path's
+  histogram expansion: build rows are counting-sorted by their owner id,
+  so a probe row's matches are one contiguous range — only the (smaller)
+  build side is ever sorted, the probe side never is.  This is the classic
+  hash-join asymmetry; the sort-merge kernel lexsorts both sides.
+
+Key equality runs over the same encoded operands the sort kernel orders
+by (ops/keys.column_operands), so null semantics (null == null) and
+string packing agree bit-for-bit across both algorithms.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from ..config import JoinType
+from ..utils import pow2ceil
+from . import common, hashing, keys
+
+_EMPTY = jnp.iinfo(jnp.int32).max
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _row_eq(ops: Sequence[jax.Array], i_idx: jax.Array,
+            j_idx: jax.Array) -> jax.Array:
+    """Vectorized row equality over encoded key operands."""
+    eq = jnp.ones(i_idx.shape, bool)
+    for o in ops:
+        a = jnp.take(o, i_idx, mode="clip")
+        b = jnp.take(o, j_idx, mode="clip")
+        eq &= a == b
+    return eq
+
+
+def _combined_key_ops(cols_l, cols_r, left_on, right_on):
+    """Concatenated (cap_l + cap_r) operand arrays comparable across
+    tables, plus the composite row hash of the concatenation."""
+    combined_cols = []
+    ops: List[jax.Array] = []
+    for ia, ib in zip(left_on, right_on):
+        c = common.concat_columns(cols_l[ia], cols_r[ib])
+        combined_cols.append(c)
+        ops.extend(keys.column_operands(c))
+    h = hashing.hash_columns(combined_cols)
+    return ops, h
+
+
+def _build(h_r: jax.Array, live_r: jax.Array, ops, cap_l: int, cap_r: int,
+           slots: int):
+    """Insert live build rows; returns (table, owner[cap_r]) where owner is
+    each build row's representative (itself, or the first-inserted row with
+    an equal key — the multimap chain head)."""
+    mask = jnp.uint32(slots - 1)
+    rid = jnp.arange(cap_r, dtype=jnp.int32)
+    grid = cap_l + rid  # global operand index of build rows
+
+    def cond(st):
+        _, _, done, _, it = st
+        return (~jnp.all(done)) & (it < slots + 2)
+
+    def body(st):
+        tab, p, done, owner, it = st
+        cand = ((h_r + p.astype(jnp.uint32)) & mask).astype(jnp.int32)
+        occ = jnp.take(tab, cand)
+        want = ~done
+        empty = occ == _EMPTY
+        # claim round: contended empty slots go to the lowest row id
+        idx = jnp.where(want & empty, cand, slots)
+        tab = tab.at[idx].min(rid, mode="drop")
+        won = want & empty & (jnp.take(tab, cand) == rid)
+        # occupied slots: equal key -> chain to owner, else advance
+        dup = want & ~empty & _row_eq(ops, grid,
+                                      cap_l + jnp.clip(occ, 0, cap_r - 1))
+        owner = jnp.where(won, rid, jnp.where(dup, occ, owner))
+        done = done | won | dup
+        p = jnp.where(want & ~empty & ~dup, p + 1, p)
+        return tab, p, done, owner, it + 1
+
+    tab0 = jnp.full((slots,), _EMPTY, jnp.int32)
+    st = (tab0, jnp.zeros((cap_r,), jnp.int32), ~live_r,
+          jnp.full((cap_r,), _EMPTY, jnp.int32), jnp.zeros((), jnp.int32))
+    tab, _, _, owner, _ = jax.lax.while_loop(cond, body, st)
+    return tab, owner
+
+
+def _probe(h_l: jax.Array, live_l: jax.Array, tab: jax.Array, ops,
+           cap_l: int, cap_r: int, slots: int):
+    """Walk each probe row's chain; returns rep[cap_l] — the matching build
+    chain head's row id, or -1 for no match."""
+    mask = jnp.uint32(slots - 1)
+    lid = jnp.arange(cap_l, dtype=jnp.int32)
+
+    def cond(st):
+        _, done, _, it = st
+        return (~jnp.all(done)) & (it < slots + 2)
+
+    def body(st):
+        p, done, rep, it = st
+        cand = ((h_l + p.astype(jnp.uint32)) & mask).astype(jnp.int32)
+        occ = jnp.take(tab, cand)
+        want = ~done
+        empty = occ == _EMPTY
+        hit = want & ~empty & _row_eq(ops, lid,
+                                      cap_l + jnp.clip(occ, 0, cap_r - 1))
+        rep = jnp.where(hit, occ, rep)
+        done = done | (want & empty) | hit
+        p = jnp.where(want & ~empty & ~hit, p + 1, p)
+        return p, done, rep, it + 1
+
+    st = (jnp.zeros((cap_l,), jnp.int32), ~live_l,
+          jnp.full((cap_l,), -1, jnp.int32), jnp.zeros((), jnp.int32))
+    _, _, rep, _ = jax.lax.while_loop(cond, body, st)
+    return rep
+
+
+def match_ranges_hash(cols_l: Tuple[Column, ...], count_l,
+                      cols_r: Tuple[Column, ...], count_r,
+                      left_on: Tuple[int, ...], right_on: Tuple[int, ...],
+                      join_type: JoinType):
+    """Hash-algorithm drop-in for join._match_ranges: same
+    (lo, matches, perm_r, live_l, unmatched_right) contract, built from a
+    hash table instead of a combined lexsort."""
+    cap_l = cols_l[0].data.shape[0]
+    cap_r = cols_r[0].data.shape[0]
+    slots = pow2ceil(2 * cap_r)
+
+    ops, h = _combined_key_ops(cols_l, cols_r, left_on, right_on)
+    h_l, h_r = h[:cap_l], h[cap_l:]
+    live_l = jnp.arange(cap_l, dtype=jnp.int32) < count_l
+    live_r = jnp.arange(cap_r, dtype=jnp.int32) < count_r
+
+    tab, owner = _build(h_r, live_r, ops, cap_l, cap_r, slots)
+    rep = _probe(h_l, live_l, tab, ops, cap_l, cap_r, slots)
+
+    # histogram of build rows per chain head -> contiguous match ranges in
+    # the owner-sorted order (the counting sort of the build side ONLY)
+    n_gid = cap_r + 1
+    gid_r = jnp.where(live_r, jnp.clip(owner, 0, cap_r - 1), cap_r)
+    counts_r = jnp.zeros((n_gid,), jnp.int32).at[gid_r].add(
+        live_r.astype(jnp.int32))
+    csum_r = jnp.cumsum(counts_r, dtype=jnp.int32)
+    rstart = jnp.concatenate([jnp.zeros((1,), jnp.int32), csum_r[:-1]])
+
+    gid_l = jnp.where(live_l & (rep >= 0), rep, cap_r)
+    lo = jnp.take(rstart, gid_l)
+    matches = jnp.where(live_l & (rep >= 0), jnp.take(counts_r, gid_l), 0)
+
+    rkey = jnp.where(live_r, gid_r, _I32_MAX)
+    iota_r = jnp.arange(cap_r, dtype=jnp.int32)
+    _, perm_r = jax.lax.sort((rkey, iota_r), num_keys=1, is_stable=True)
+
+    if join_type in (JoinType.RIGHT, JoinType.FULL_OUTER):
+        counts_l = jnp.zeros((n_gid,), jnp.int32).at[gid_l].add(
+            live_l.astype(jnp.int32))
+        unmatched_r = live_r & (jnp.take(counts_l, gid_r) == 0)
+    else:
+        unmatched_r = jnp.zeros((cap_r,), bool)
+    return lo, matches, perm_r, live_l, unmatched_r

@@ -89,29 +89,45 @@ def _emission(matches, live_l, join_type: JoinType):
     return emit, csum, total
 
 
-@partial(jax.jit, static_argnames=("left_on", "right_on", "join_type"))
+def _ranges(cols_l, count_l, cols_r, count_r, left_on, right_on, join_type,
+            algorithm: str):
+    if algorithm == "hash":
+        from . import hash_join
+
+        return hash_join.match_ranges_hash(
+            cols_l, count_l, cols_r, count_r, left_on, right_on, join_type)
+    return _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
+                         join_type)
+
+
+@partial(jax.jit, static_argnames=("left_on", "right_on", "join_type",
+                                   "algorithm"))
 def join_row_count(cols_l: Tuple[Column, ...], count_l,
                    cols_r: Tuple[Column, ...], count_r,
                    left_on: Tuple[int, ...], right_on: Tuple[int, ...],
-                   join_type: JoinType):
+                   join_type: JoinType, algorithm: str = "sort"):
     """Exact output row count of the join (device scalar)."""
-    lo, matches, perm_r, live_l, unmatched_r = _match_ranges(
-        cols_l, count_l, cols_r, count_r, left_on, right_on, join_type)
+    lo, matches, perm_r, live_l, unmatched_r = _ranges(
+        cols_l, count_l, cols_r, count_r, left_on, right_on, join_type,
+        algorithm)
     _, _, total = _emission(matches, live_l, join_type)
     if join_type in (JoinType.RIGHT, JoinType.FULL_OUTER):
         total = total + jnp.sum(unmatched_r, dtype=jnp.int32)
     return total
 
 
-@partial(jax.jit, static_argnames=("left_on", "right_on", "join_type", "out_capacity"))
+@partial(jax.jit, static_argnames=("left_on", "right_on", "join_type",
+                                   "out_capacity", "algorithm"))
 def join_gather(cols_l: Tuple[Column, ...], count_l,
                 cols_r: Tuple[Column, ...], count_r,
                 left_on: Tuple[int, ...], right_on: Tuple[int, ...],
-                join_type: JoinType, out_capacity: int):
+                join_type: JoinType, out_capacity: int,
+                algorithm: str = "sort"):
     """Produce gathered output columns (left columns ++ right columns) with
     capacity ``out_capacity`` and the dynamic output row count."""
-    lo, matches, perm_r, live_l, unmatched_r = _match_ranges(
-        cols_l, count_l, cols_r, count_r, left_on, right_on, join_type)
+    lo, matches, perm_r, live_l, unmatched_r = _ranges(
+        cols_l, count_l, cols_r, count_r, left_on, right_on, join_type,
+        algorithm)
     emit, csum, total = _emission(matches, live_l, join_type)
 
     k = jnp.arange(out_capacity, dtype=jnp.int32)

@@ -65,6 +65,21 @@ def _counts_for(t, key_idx: Tuple[int, ...], mode: str, opts: SortOptions | None
     return _shard_map(ctx, fn, ("counts", key_idx, mode, opts), _shapes_key(t))(t)
 
 
+def _targets_and_counts(t, key_idx: Tuple[int, ...], mode: str,
+                        opts: SortOptions | None):
+    """One targets pass returning (sharded targets array, count matrix) —
+    the exchange program reuses the targets instead of re-hashing."""
+    world = t.num_shards
+    ctx = t.ctx
+
+    def fn(tt):
+        tgt = _targets(tt, key_idx, world, mode, opts)
+        return tgt, shuffle_mod.target_counts(tgt, world)
+
+    return _shard_map(ctx, fn, ("targets+counts", key_idx, mode, opts),
+                      _shapes_key(t))(t)
+
+
 def _targets(tt, key_idx, world, mode, opts: SortOptions | None):
     count = tt.row_counts[0]
     if mode == "hash":
@@ -77,21 +92,94 @@ def _targets(tt, key_idx, world, mode, opts: SortOptions | None):
         ascending=opts.ascending, nulls_first=opts.nulls_first)
 
 
+_RAGGED_A2A: bool | None = None  # None = unprobed; False = backend lacks it
+
+
+def _probe_ragged(ctx) -> bool:
+    """One tiny RaggedAllToAll program on the context's mesh: each rank
+    sends one element to every rank.  Compile+run success means the
+    backend implements the collective (XLA:CPU currently does not); any
+    failure here is a capability miss, so real shuffle errors are never
+    misclassified as fallback triggers."""
+    from jax.sharding import PartitionSpec as P
+
+    world = ctx.GetWorldSize()
+
+    def fn(x):
+        me = jax.lax.axis_index(PARTITION_AXIS)
+        out = jnp.zeros((world,), jnp.int32)
+        io = jnp.arange(world, dtype=jnp.int32)
+        ones = jnp.ones((world,), jnp.int32)
+        oo = jnp.full((world,), me, jnp.int32)
+        return jax.lax.ragged_all_to_all(x, out, io, ones, oo, ones,
+                                         axis_name=PARTITION_AXIS)
+
+    try:
+        f = jax.jit(jax.shard_map(fn, mesh=ctx.mesh, in_specs=P(PARTITION_AXIS),
+                                  out_specs=P(PARTITION_AXIS), check_vma=False))
+        jax.block_until_ready(f(jnp.zeros((world * world,), jnp.int32)))
+        return True
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).info(
+            "ragged all_to_all unavailable on this backend (%s); "
+            "using bucketed shuffle", type(e).__name__)
+        return False
+
+
+def _ragged_enabled(ctx) -> bool:
+    import os
+
+    global _RAGGED_A2A
+    env = os.environ.get("CYLON_TPU_SHUFFLE")
+    if env == "bucketed":
+        return False
+    if _RAGGED_A2A is None:
+        _RAGGED_A2A = _probe_ragged(ctx)
+    if env == "ragged" and not _RAGGED_A2A:
+        raise RuntimeError(
+            "CYLON_TPU_SHUFFLE=ragged requested but this backend does not "
+            "implement RaggedAllToAll")
+    return _RAGGED_A2A
+
+
 def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
               opts: SortOptions | None = None):
-    """partition -> all-to-all -> compact; returns a new distributed Table."""
+    """partition -> all-to-all -> compact; returns a new distributed Table.
+
+    The exchange prefers the skew-proof RaggedAllToAll path (exact traffic,
+    no bucket padding, targets computed once); if the active backend lacks
+    the ragged collective the bucketed path is used and remembered.
+    """
     from ..table import Table
     from ..utils import span
 
     world = t.num_shards
     ctx = t.ctx
+    names = t.names
+
     # phase timers mirror the reference's split/shuffle chrono spans
     # (partition/partition.cpp:29-57, table.cpp:163-175)
+    if _ragged_enabled(ctx):
+        with span("shuffle.plan"):
+            targets, counts = _targets_and_counts(t, key_idx, mode, opts)
+            _, out_cap = shuffle_mod.plan_shuffle(
+                np.asarray(counts).reshape(world, world))
+
+        def rfn(tt, tgt):
+            cols, total = shuffle_mod.shuffle_shard_ragged(
+                tt.columns, tgt, world, out_cap)
+            return Table(cols, jnp.reshape(total, (1,)), names, ctx)
+
+        with span("shuffle.exchange"):
+            return _shard_map(ctx, rfn, ("shuffle-ragged", key_idx, out_cap),
+                              _shapes_key(t))(t, targets)
+
     with span("shuffle.plan"):
         counts = _counts_for(t, key_idx, mode, opts)
         bucket, out_cap = shuffle_mod.plan_shuffle(
             np.asarray(counts).reshape(world, world))
-    names = t.names
 
     def fn(tt):
         tgt = _targets(tt, key_idx, world, mode, opts)
@@ -108,6 +196,47 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
 def shuffle(t, key_idx: Tuple[int, ...]):
     """Hash-repartition rows so equal keys land on the same shard."""
     return _shuffled(t, tuple(key_idx), "hash")
+
+
+def hash_partition(t, key_idx: Tuple[int, ...], num_partitions: int):
+    """Public HashPartition (reference: table.cpp:358-375): split rows into
+    ``num_partitions`` tables by key hash.  Purely local like the reference
+    (each rank/shard splits its own rows; no exchange): partition p's table
+    holds, on every shard, that shard's rows hashing to p, front-packed.
+    Returns ``{partition_id: Table}``."""
+    from ..ops import compact as compact_mod
+    from ..table import Table, _shard_wise
+
+    ctx = t.ctx
+    names = t.names
+    key_idx = tuple(key_idx)
+
+    def cfn(tt):
+        tgt = partition_mod.hash_targets(tt.columns, tt.row_counts[0],
+                                         key_idx, num_partitions)
+        return shuffle_mod.target_counts(tgt, num_partitions)
+
+    from ..utils import pow2ceil
+
+    counts = _shard_wise(ctx, cfn, t, key=("hp_counts", key_idx, num_partitions))
+    cm = np.asarray(counts).reshape(t.num_shards, num_partitions)
+    caps = tuple(min(pow2ceil(c), t.shard_capacity) for c in cm.max(axis=0))
+
+    def pfn(tt):
+        tgt = partition_mod.hash_targets(tt.columns, tt.row_counts[0],
+                                         key_idx, num_partitions)
+        outs = []
+        for p in range(num_partitions):
+            perm, m = compact_mod.compact_indices(tgt == p)
+            idx = perm[: caps[p]]
+            valid = jnp.arange(caps[p], dtype=jnp.int32) < m
+            cols = tuple(c.take(idx, valid_mask=valid) for c in tt.columns)
+            outs.append(Table(cols, jnp.reshape(m, (1,)), names, ctx))
+        return tuple(outs)
+
+    parts = _shard_wise(ctx, pfn, t,
+                        key=("hash_partition", key_idx, num_partitions, caps))
+    return {p: parts[p] for p in range(num_partitions)}
 
 
 # ---------------------------------------------------------------------------
@@ -159,11 +288,38 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
     """
     from ..table import Table, _groupby_output_names, _local_groupby, _shard_wise
 
-    if any(op == AggOp.NUNIQUE for _, op in aggs):
-        raise NotImplementedError("distributed NUNIQUE not yet supported")
-
     names_out = _groupby_output_names(t, by_idx, aggs)
     ctx = t.ctx
+
+    if any(op == AggOp.NUNIQUE for _, op in aggs):
+        # NUNIQUE does not decompose into partial+combine columns; instead
+        # co-locate raw rows by key (shuffle) and run ONE local group-by —
+        # exact, because groups are disjoint across shards after the
+        # shuffle.  When every agg is NUNIQUE, traffic shrinks first via a
+        # local distinct pass over the involved columns (duplicate
+        # (key,value) rows cannot change a distinct count).
+        from ..ops import unique as unique_mod
+
+        involved = tuple(dict.fromkeys(
+            tuple(by_idx) + tuple(ci for ci, _ in aggs)))
+        work = t.project(involved)  # shuffle only the columns the aggs touch
+        remap = {ci: i for i, ci in enumerate(involved)}
+        by_p = tuple(remap[i] for i in by_idx)
+        aggs_p = tuple((remap[ci], op) for ci, op in aggs)
+        if all(op == AggOp.NUNIQUE for _, op in aggs):
+            nn = work.names
+
+            def dedup_fn(tt):
+                cols, m = unique_mod.unique(
+                    tt.columns, tt.row_counts[0],
+                    tuple(range(len(involved))), "first")
+                return Table(cols, jnp.reshape(m, (1,)), nn, ctx)
+
+            work = _shard_wise(ctx, dedup_fn, work,
+                               key=("nunique_dedup", involved))
+        shuffled = shuffle(work, by_p)
+        out = _local_groupby(shuffled, by_p, aggs_p, ddof, pipeline=False)
+        return out.rename(names_out)
 
     # 1. expand requested aggs into partial ops, dedup
     partial_list: list = []          # (src_col_idx, partial_op)
@@ -255,24 +411,27 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
 # ---------------------------------------------------------------------------
 
 def distributed_scalar_agg(t, col_idx: int, op: agg_mod.ReduceOp):
+    """Local masked reduce + ONE collective combine, all in a single program
+    (the shape of the reference's arrow::compute + mpi::AllReduce,
+    compute/aggregates.cpp:30-156).  Empty shards contribute the op's
+    neutral element (scalar_agg's sentinels), so no host-side masking."""
+    from . import collectives
+
     ctx = t.ctx
 
     def fn(tt):
         v, n = agg_mod.scalar_agg(tt.columns[col_idx], tt.row_counts[0], op)
-        return jnp.reshape(v, (1,)), jnp.reshape(n, (1,))
+        if op in (agg_mod.ReduceOp.SUM, agg_mod.ReduceOp.COUNT):
+            r = collectives.allreduce_sum(v)
+        elif op == agg_mod.ReduceOp.MIN:
+            r = collectives.allreduce_min(v)
+        elif op == agg_mod.ReduceOp.MAX:
+            r = collectives.allreduce_max(v)
+        elif op == agg_mod.ReduceOp.PROD:  # XLA has no pprod collective
+            r = jnp.prod(collectives.allgather(jnp.reshape(v, (1,))))
+        else:
+            raise ValueError(op)
+        return jnp.reshape(r, (1,))
 
-    vals, ns = _shard_map(ctx, fn, ("scalar", col_idx, op), _shapes_key(t))(t)
-    vals = np.asarray(vals)
-    ns = np.asarray(ns)
-    mask = ns > 0
-    if op in (agg_mod.ReduceOp.SUM, agg_mod.ReduceOp.COUNT):
-        return jnp.asarray(vals.sum())
-    if op == agg_mod.ReduceOp.PROD:
-        return jnp.asarray(vals[mask].prod() if mask.any() else 1)
-    if not mask.any():
-        return jnp.asarray(vals[0])
-    if op == agg_mod.ReduceOp.MIN:
-        return jnp.asarray(vals[mask].min())
-    if op == agg_mod.ReduceOp.MAX:
-        return jnp.asarray(vals[mask].max())
-    raise ValueError(op)
+    vals = _shard_map(ctx, fn, ("scalar", col_idx, op), _shapes_key(t))(t)
+    return vals[0]

@@ -105,8 +105,80 @@ def plan_shuffle(counts: jax.Array) -> Tuple[int, int]:
     out_capacity), both rounded to powers of two to bound recompilation."""
     import numpy as np
 
+    from ..utils import pow2ceil
+
     cm = np.asarray(counts)
     bucket = int(cm.max()) if cm.size else 0
     incoming = cm.sum(axis=0).max() if cm.size else 0
-    p2 = lambda n: 1 << max(3, (max(1, int(n)) - 1).bit_length())
-    return p2(bucket), p2(incoming)
+    return pow2ceil(bucket), pow2ceil(incoming)
+
+
+def ragged_plan(cm, me):
+    """Rank ``me``'s RaggedAllToAll sizing from the [world, world] count
+    matrix (cm[src, dst] = rows src sends to dst): (recv_sizes,
+    output_offsets, total).  ``output_offsets[t]`` is where my slice lands
+    on receiver t — after every lower-ranked source's slice — so received
+    rows arrive front-packed with no compaction pass.  Pure math shared by
+    the device kernel and the host-side emulation tests."""
+    world = cm.shape[0]
+    recv_sizes = cm[:, me]
+    src_rank = jnp.arange(world, dtype=jnp.int32)
+    output_offsets = jnp.sum(
+        jnp.where((src_rank < me)[:, None], cm, 0), axis=0).astype(jnp.int32)
+    total = jnp.sum(recv_sizes, dtype=jnp.int32)
+    return recv_sizes, output_offsets, total
+
+
+def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
+                         world: int, out_capacity: int):
+    """Skew-proof shard-local shuffle body over ``lax.ragged_all_to_all``.
+
+    Where ``shuffle_shard`` pads every (src,dst) pair to one static bucket
+    (traffic ``world x bucket`` rows per buffer — up to ~world x inflation
+    when one shard is hot), this variant sends *exactly* the rows that
+    exist: rows are stable-sorted by target so each destination's slice is
+    contiguous, the all-gathered count matrix yields send/recv sizes and
+    the packed output offsets, and XLA's RaggedAllToAll moves the slices.
+    Received rows land front-packed, so no compaction gather is needed.
+
+    ``targets`` is taken as an argument (not recomputed) so the caller can
+    reuse the targets pass that sized ``out_capacity`` — the reference
+    similarly partitions once and streams only what exists
+    (cpp/src/cylon/arrow/arrow_all_to_all.cpp:24-236).
+    """
+    cap = cols[0].data.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+
+    counts = target_counts(targets, world)
+    _, perm_t = jax.lax.sort((targets, iota), num_keys=1, is_stable=True)
+    input_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)[:-1]])
+
+    # on-device count-matrix exchange (the 6-int header protocol's job)
+    cm = collectives.allgather(counts, axis=0).reshape(world, world)
+    me = collectives.my_rank()
+    recv_sizes, output_offsets, total = ragged_plan(cm, me)
+
+    from ..context import PARTITION_AXIS
+
+    def exchange(buf):
+        squeeze = buf.ndim == 1
+        if squeeze:  # RaggedAllToAll wants a payload axis
+            buf = buf[:, None]
+        orig = buf.dtype
+        if orig == jnp.bool_:
+            buf = buf.astype(jnp.uint8)
+        sorted_buf = jnp.take(buf, perm_t, axis=0)
+        out = jnp.zeros((out_capacity,) + buf.shape[1:], buf.dtype)
+        got = jax.lax.ragged_all_to_all(
+            sorted_buf, out, input_offsets, counts, output_offsets,
+            recv_sizes, axis_name=PARTITION_AXIS)
+        if orig == jnp.bool_:
+            got = got.astype(jnp.bool_)
+        return got[:, 0] if squeeze else got
+
+    out_cols = tuple(
+        Column(exchange(c.data), exchange(c.validity),
+               None if c.lengths is None else exchange(c.lengths), c.dtype)
+        for c in cols)
+    return out_cols, total

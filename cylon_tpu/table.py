@@ -30,7 +30,7 @@ import numpy as np
 from . import column as column_mod
 from . import dtypes
 from .column import Column
-from .config import JoinConfig, JoinType, SortOptions
+from .config import JoinAlgorithm, JoinConfig, JoinType, SortOptions
 from .context import PARTITION_AXIS, CylonContext, default_context
 from .ops import aggregates as agg_mod
 from .ops import compact as compact_mod
@@ -45,8 +45,7 @@ from .status import Code, CylonError
 ColumnRef = Union[int, str]
 
 
-def _pow2ceil(n: int) -> int:
-    return 1 << max(3, (int(n) - 1).bit_length())
+from .utils import pow2ceil as _pow2ceil
 
 
 @jax.tree_util.register_dataclass
@@ -711,6 +710,17 @@ class Table:
 
         return par_ops.shuffle(self, self._resolve_many(refs))
 
+    def hash_partition(self, refs, num_partitions: int) -> Dict[int, "Table"]:
+        """Split into ``num_partitions`` tables by key hash, shard-locally
+        (reference: HashPartition, table.cpp:358-375)."""
+        if num_partitions < 1:
+            raise CylonError(Code.Invalid,
+                             f"num_partitions must be >= 1, got {num_partitions}")
+        from .parallel import ops as par_ops
+
+        return par_ops.hash_partition(self, self._resolve_many(refs),
+                                      num_partitions)
+
 
 class _RowEnv:
     """Column namespace handed to select() predicates."""
@@ -834,9 +844,12 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
     ctx = left.ctx
     jt = cfg.join_type
 
+    algo = "hash" if cfg.algorithm == JoinAlgorithm.HASH else "sort"
+
     def count_fn(a: Table, b: Table):
         c = join_mod.join_row_count(a.columns, a.row_counts[0], b.columns,
-                                    b.row_counts[0], cfg.left_on, cfg.right_on, jt)
+                                    b.row_counts[0], cfg.left_on, cfg.right_on,
+                                    jt, algo)
         return jnp.reshape(c, (1,))
 
     # sizing pass + gather pass, the 2-pass Reserve/build of the reference's
@@ -844,18 +857,20 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
     # (join.cpp:89-253 phase timers)
     with span("join.count"):
         counts = _shard_wise(ctx, count_fn, left, right,
-                             key=("join_count", cfg.left_on, cfg.right_on, jt))
+                             key=("join_count", cfg.left_on, cfg.right_on, jt,
+                                  algo))
         out_cap = _cap_round(max(1, int(jnp.max(counts))))
 
     def gather_fn(a: Table, b: Table) -> Table:
         cols, m = join_mod.join_gather(a.columns, a.row_counts[0], b.columns,
                                        b.row_counts[0], cfg.left_on, cfg.right_on,
-                                       jt, out_cap)
+                                       jt, out_cap, algo)
         return Table(cols, jnp.reshape(m, (1,)), names, ctx)
 
     with span("join.gather"):
         return _shard_wise(ctx, gather_fn, left, right,
-                           key=("join", cfg.left_on, cfg.right_on, jt, out_cap))
+                           key=("join", cfg.left_on, cfg.right_on, jt, out_cap,
+                                algo))
 
 
 def _local_set_op(a: Table, b: Table, op: str) -> Table:

@@ -18,6 +18,13 @@ def generate_uuid_v4() -> str:
     return str(_uuid.uuid4())
 
 
+def pow2ceil(n: int, min_size: int = 8) -> int:
+    """Smallest power of two >= n (>=1), floored at ``min_size`` — the one
+    capacity-rounding rule shared by every planner and kernel so shard
+    capacities never disagree."""
+    return max(min_size, 1 << (max(1, int(n)) - 1).bit_length())
+
+
 def to_string(value, quote_strings: bool = False) -> str:
     """CSV-ish scalar rendering used by Table.print (reference:
     util/to_string.hpp): nulls print empty, strings optionally quoted."""
