@@ -37,8 +37,9 @@ from ..config import JoinType
 from ..utils import pow2ceil
 from . import common, hashing, keys
 
+# empty-slot sentinel; also the gid sort key that exiles padding rows to
+# the back (both want "larger than any real row id", so one constant)
 _EMPTY = jnp.iinfo(jnp.int32).max
-_I32_MAX = jnp.iinfo(jnp.int32).max
 
 
 def _row_eq(ops: Sequence[jax.Array], i_idx: jax.Array,
@@ -165,7 +166,7 @@ def match_ranges_hash(cols_l: Tuple[Column, ...], count_l,
     lo = jnp.take(rstart, gid_l)
     matches = jnp.where(live_l & (rep >= 0), jnp.take(counts_r, gid_l), 0)
 
-    rkey = jnp.where(live_r, gid_r, _I32_MAX)
+    rkey = jnp.where(live_r, gid_r, _EMPTY)
     iota_r = jnp.arange(cap_r, dtype=jnp.int32)
     _, perm_r = jax.lax.sort((rkey, iota_r), num_keys=1, is_stable=True)
 

@@ -106,7 +106,9 @@ def _hash_partition_padded(flat_words, nwords: Tuple[int, ...], world: int,
     block_rows = min(rows, _BLOCK_ROWS)
     if rows % block_rows:  # caller pads to a whole number of grid blocks
         raise ValueError(f"rows {rows} not a multiple of block {block_rows}")
-    spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    # the literal 0 must be typed: under jax_enable_x64 a bare Python 0
+    # traces as i64 and Mosaic rejects the (i32, i64) index-map signature
+    spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, jnp.int32(0)))
     shaped = [w.reshape(rows, _LANES) for w in flat_words]
     h, t = pl.pallas_call(
         functools.partial(_hash_kernel, nwords, world),

@@ -214,17 +214,21 @@ def hash_partition(t, key_idx: Tuple[int, ...], num_partitions: int):
     def cfn(tt):
         tgt = partition_mod.hash_targets(tt.columns, tt.row_counts[0],
                                          key_idx, num_partitions)
-        return shuffle_mod.target_counts(tgt, num_partitions)
+        return tgt, shuffle_mod.target_counts(tgt, num_partitions)
 
     from ..utils import pow2ceil
 
-    counts = _shard_wise(ctx, cfn, t, key=("hp_counts", key_idx, num_partitions))
+    one_shard = t.num_shards == 1
+    if one_shard:
+        targets, counts = cfn(t)
+    else:
+        targets, counts = _shard_map(ctx, cfn,
+                                     ("hp_counts", key_idx, num_partitions),
+                                     _shapes_key(t))(t)
     cm = np.asarray(counts).reshape(t.num_shards, num_partitions)
     caps = tuple(min(pow2ceil(c), t.shard_capacity) for c in cm.max(axis=0))
 
-    def pfn(tt):
-        tgt = partition_mod.hash_targets(tt.columns, tt.row_counts[0],
-                                         key_idx, num_partitions)
+    def pfn(tt, tgt):
         outs = []
         for p in range(num_partitions):
             perm, m = compact_mod.compact_indices(tgt == p)
@@ -234,8 +238,12 @@ def hash_partition(t, key_idx: Tuple[int, ...], num_partitions: int):
             outs.append(Table(cols, jnp.reshape(m, (1,)), names, ctx))
         return tuple(outs)
 
-    parts = _shard_wise(ctx, pfn, t,
-                        key=("hash_partition", key_idx, num_partitions, caps))
+    if one_shard:
+        parts = pfn(t, targets)
+    else:
+        parts = _shard_map(ctx, pfn,
+                           ("hash_partition", key_idx, num_partitions, caps),
+                           _shapes_key(t))(t, targets)
     return {p: parts[p] for p in range(num_partitions)}
 
 

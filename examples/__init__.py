@@ -1,0 +1,2 @@
+"""Runnable examples + BASELINE workload drivers (reference analog:
+cpp/src/examples/*.cpp, which double as smoke tests and benchmarks)."""

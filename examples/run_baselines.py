@@ -1,0 +1,48 @@
+"""Run every BASELINE.json workload config and print one JSON line each.
+
+Scale presets:
+  small — CPU test mesh / CI (default)
+  full  — TPU-sized runs (SF-1 Q1, 100M+-row shuffle)
+
+Usage: python -m examples.run_baselines [small|full]
+"""
+from __future__ import annotations
+
+import sys
+
+from . import etl_to_flax, join_csv, shuffle_bench, tpch_q1, tpch_q5
+from .util import log
+
+PRESETS = {
+    "small": dict(join_rows=100_000, q1_sf=0.05, shuffle_rows=1 << 20,
+                  q5_sf=0.01, events=100_000),
+    "full": dict(join_rows=5_000_000, q1_sf=1.0, shuffle_rows=1 << 27,
+                 q5_sf=0.1, events=2_000_000),
+}
+
+
+def main() -> int:
+    preset = sys.argv[1] if len(sys.argv) > 1 else "small"
+    p = PRESETS[preset]
+    log(f"preset={preset}")
+    results = []
+    for name, fn in [
+        ("join_csv", lambda: join_csv.run(p["join_rows"])),
+        ("tpch_q1", lambda: tpch_q1.run(p["q1_sf"])),
+        ("shuffle", lambda: shuffle_bench.run(p["shuffle_rows"])),
+        ("tpch_q5", lambda: tpch_q5.run(p["q5_sf"])),
+        ("etl_to_flax", lambda: etl_to_flax.run(p["events"])),
+    ]:
+        log(f"running {name} ...")
+        try:
+            results.append(fn())
+        except Exception as e:  # keep the harness going; report the failure
+            log(f"{name} FAILED: {type(e).__name__}: {e}")
+            results.append({"config": name, "error": str(e)[:200]})
+    failures = [r for r in results if "error" in r]
+    log(f"done: {len(results) - len(failures)}/{len(results)} configs ok")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""BASELINE config 3 — large hash shuffle / repartition over the mesh.
+
+The 1B-row target runs on a v5e-8 pod; this harness scales rows to the
+available devices and memory (``rows`` arg) and reports shuffled
+rows/sec, so the same driver measures a CPU test mesh, a single chip, or
+a pod.  Reference analog: Shuffle (table.cpp:951-964) under the scaling
+experiments cpp/src/experiments/run_dist_scaling.py.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .util import default_ctx, emit, table_from_arrays
+
+
+def run(rows: int = 1 << 20, world: int | None = None, seed: int = 0,
+        reps: int = 3) -> dict:
+    ctx = default_ctx(world)
+    rng = np.random.default_rng(seed)
+    data = {
+        "k": rng.integers(0, max(rows, 1), rows).astype(np.int32),
+        "a": rng.random(rows).astype(np.float32),
+        "b": rng.integers(0, 1 << 30, rows).astype(np.int32),
+    }
+    t = table_from_arrays(data, ctx)
+
+    s = t.shuffle(["k"])  # warm-up: compile + plan
+    assert s.row_count == rows
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        s = t.shuffle(["k"])
+        assert s.row_count == rows  # blocks on the exchange
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    return emit("shuffle", rows=rows, seconds=dt, rows_per_sec=rows / dt,
+                world=ctx.GetWorldSize(), reps=reps)
+
+
+if __name__ == "__main__":
+    import sys
+
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    run(rows)

