@@ -54,11 +54,25 @@ class TPUConfig(CommConfig):
     net/mpi/mpi_communicator.cpp:27-49).
 
     devices: explicit device list; default = all of ``jax.devices()``.
+
+    Multi-host (the reference's multi-node MPI world,
+    net/mpi/mpi_communicator.cpp:51-60 MPI_Init + COMM_WORLD): pass
+    ``coordinator_address`` + ``num_processes`` + ``process_id`` and every
+    process joins one global mesh via ``jax.distributed.initialize`` —
+    collectives then ride ICI within a slice and DCN across hosts.
     """
 
-    def __init__(self, devices=None, world_size: Optional[int] = None):
+    def __init__(self, devices=None, world_size: Optional[int] = None,
+                 coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 local_device_ids=None):
         self.devices = devices
         self.world_size = world_size
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.local_device_ids = local_device_ids
 
     def comm_type(self) -> CommType:
         return CommType.TPU
@@ -85,6 +99,15 @@ class CylonContext:
             self.devices = np.array(jax.devices()[:1])
         else:
             cfg = config if isinstance(config, TPUConfig) else TPUConfig()
+            if cfg.num_processes is not None and cfg.num_processes > 1:
+                # the MPI_Init moment: join the global runtime before any
+                # backend initializes, so jax.devices() spans every host
+                if not jax.distributed.is_initialized():
+                    jax.distributed.initialize(
+                        coordinator_address=cfg.coordinator_address,
+                        num_processes=cfg.num_processes,
+                        process_id=cfg.process_id,
+                        local_device_ids=cfg.local_device_ids)
             devs = list(cfg.devices) if cfg.devices is not None else list(jax.devices())
             if cfg.world_size is not None:
                 devs = devs[: cfg.world_size]

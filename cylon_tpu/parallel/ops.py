@@ -23,21 +23,25 @@ from ..ops import aggregates as agg_mod
 from ..ops import groupby as groupby_mod
 from ..ops import sort as sort_mod
 from ..ops.groupby import AggOp
+from . import collectives
 from . import partition as partition_mod
 from . import shuffle as shuffle_mod
 
 _PLAN_CACHE: Dict[tuple, object] = {}
 
 
-def _shard_map(ctx: CylonContext, fn, key: tuple, shapes_key: tuple):
+def _shard_map(ctx: CylonContext, fn, key: tuple, shapes_key: tuple,
+               out_specs=None):
     from jax.sharding import PartitionSpec as P
 
     cache_key = (key, id(ctx), shapes_key)
     entry = _PLAN_CACHE.get(cache_key)
     if entry is None:
         spec = P(PARTITION_AXIS)
-        entry = jax.jit(jax.shard_map(fn, mesh=ctx.mesh, in_specs=spec,
-                                      out_specs=spec, check_vma=False))
+        entry = jax.jit(jax.shard_map(
+            fn, mesh=ctx.mesh, in_specs=spec,
+            out_specs=spec if out_specs is None else out_specs,
+            check_vma=False))
         _PLAN_CACHE[cache_key] = entry
     return entry
 
@@ -54,30 +58,40 @@ def _shapes_key(t) -> tuple:
 # ---------------------------------------------------------------------------
 
 def _counts_for(t, key_idx: Tuple[int, ...], mode: str, opts: SortOptions | None):
-    """[world, world] count matrix for a prospective shuffle."""
+    """[world, world] count matrix for a prospective shuffle, replicated on
+    every process (multi-host planners need it host-side everywhere)."""
+    from jax.sharding import PartitionSpec as P
+
     world = t.num_shards
     ctx = t.ctx
 
     def fn(tt):
         tgt = _targets(tt, key_idx, world, mode, opts)
-        return shuffle_mod.target_counts(tgt, world)  # [world] per shard
+        counts = shuffle_mod.target_counts(tgt, world)  # [world] per shard
+        return collectives.allgather(counts, axis=0).reshape(world, world)
 
-    return _shard_map(ctx, fn, ("counts", key_idx, mode, opts), _shapes_key(t))(t)
+    return _shard_map(ctx, fn, ("counts", key_idx, mode, opts), _shapes_key(t),
+                      out_specs=P())(t)
 
 
 def _targets_and_counts(t, key_idx: Tuple[int, ...], mode: str,
                         opts: SortOptions | None):
-    """One targets pass returning (sharded targets array, count matrix) —
-    the exchange program reuses the targets instead of re-hashing."""
+    """One targets pass returning (sharded targets array, replicated
+    [world, world] count matrix) — the exchange program reuses the targets
+    instead of re-hashing, and every process can size the plan."""
+    from jax.sharding import PartitionSpec as P
+
     world = t.num_shards
     ctx = t.ctx
 
     def fn(tt):
         tgt = _targets(tt, key_idx, world, mode, opts)
-        return tgt, shuffle_mod.target_counts(tgt, world)
+        counts = shuffle_mod.target_counts(tgt, world)
+        return tgt, collectives.allgather(counts, axis=0).reshape(world, world)
 
     return _shard_map(ctx, fn, ("targets+counts", key_idx, mode, opts),
-                      _shapes_key(t))(t)
+                      _shapes_key(t),
+                      out_specs=(P(PARTITION_AXIS), P()))(t)
 
 
 def _targets(tt, key_idx, world, mode, opts: SortOptions | None):
@@ -211,21 +225,29 @@ def hash_partition(t, key_idx: Tuple[int, ...], num_partitions: int):
     names = t.names
     key_idx = tuple(key_idx)
 
-    def cfn(tt):
-        tgt = partition_mod.hash_targets(tt.columns, tt.row_counts[0],
-                                         key_idx, num_partitions)
-        return tgt, shuffle_mod.target_counts(tgt, num_partitions)
+    from jax.sharding import PartitionSpec as P
 
     from ..utils import pow2ceil
 
-    one_shard = t.num_shards == 1
+    nshards = t.num_shards
+    one_shard = nshards == 1
     if one_shard:
-        targets, counts = cfn(t)
+        targets = partition_mod.hash_targets(t.columns, t.row_counts[0],
+                                             key_idx, num_partitions)
+        counts = shuffle_mod.target_counts(targets, num_partitions)
     else:
+        def cfn(tt):
+            tgt = partition_mod.hash_targets(tt.columns, tt.row_counts[0],
+                                             key_idx, num_partitions)
+            cnts = shuffle_mod.target_counts(tgt, num_partitions)
+            return tgt, collectives.allgather(cnts, axis=0).reshape(
+                nshards, num_partitions)
+
         targets, counts = _shard_map(ctx, cfn,
                                      ("hp_counts", key_idx, num_partitions),
-                                     _shapes_key(t))(t)
-    cm = np.asarray(counts).reshape(t.num_shards, num_partitions)
+                                     _shapes_key(t),
+                                     out_specs=(P(PARTITION_AXIS), P()))(t)
+    cm = np.asarray(counts).reshape(nshards, num_partitions)
     caps = tuple(min(pow2ceil(c), t.shard_capacity) for c in cm.max(axis=0))
 
     def pfn(tt, tgt):

@@ -200,14 +200,27 @@ class Table:
         """Collect live rows of every shard into one local column set."""
         if self.num_shards == 1:
             return list(self.columns), int(self.row_counts[0])
-        counts = np.asarray(jax.device_get(self.row_counts))
+
+        # ONE host transfer for the whole table (a pytree gather); on
+        # multi-host the shards live on remote processes, so the gather is
+        # a cross-process all-gather (the reference's analog is a
+        # gather-to-rank pattern over MPI)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            counts_h, cols_h = multihost_utils.process_allgather(
+                (self.row_counts, self.columns), tiled=True)
+        else:
+            counts_h, cols_h = jax.device_get((self.row_counts, self.columns))
+
+        counts = np.asarray(counts_h)
         cap = self.shard_capacity
         total = int(counts.sum())
         out_cols: List[Column] = []
-        for col in self.columns:
-            data = np.asarray(jax.device_get(col.data))
-            validity = np.asarray(jax.device_get(col.validity))
-            lengths = None if col.lengths is None else np.asarray(jax.device_get(col.lengths))
+        for col, col_h in zip(self.columns, cols_h):
+            data = np.asarray(col_h.data)
+            validity = np.asarray(col_h.validity)
+            lengths = None if col.lengths is None else np.asarray(col_h.lengths)
             parts_d, parts_v, parts_l = [], [], []
             for s in range(self.num_shards):
                 lo, hi = s * cap, s * cap + int(counts[s])
@@ -523,7 +536,7 @@ class Table:
                              f"value length {arr.shape[0]} != rows {self.row_count}")
         if self.num_shards == 1:
             return column_mod.from_numpy(arr, capacity=self.capacity)
-        counts = np.asarray(jax.device_get(self.row_counts))
+        counts = _host_row_counts(self)
         cap = self.shard_capacity
         off = 0
         shard_cols = []
@@ -769,6 +782,16 @@ def _shard_wise(ctx: CylonContext, fn, *tables: Table, key: tuple):
                                       out_specs=spec, check_vma=False))
         _SHARD_FN_CACHE[cache_key] = entry
     return entry(*tables)
+
+
+def _host_row_counts(t: Table) -> np.ndarray:
+    """Per-shard row counts as a host array, valid on every process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(
+            t.row_counts, tiled=True))
+    return np.asarray(jax.device_get(t.row_counts))
 
 
 def _check_schemas(a: Table, b: Table) -> None:
