@@ -33,8 +33,33 @@ import numpy as np
 
 from . import dtypes
 from .dtypes import DataType, Type
+from .status import Code, CylonError
 
 DEFAULT_STRING_WIDTH = 32
+
+
+def max_string_width() -> int:
+    """HBM guard: the widest byte matrix a string column may ingest with
+    (capacity x width bytes live in device memory).  One oversized cell
+    otherwise inflates the whole column — the overflow policy is an error
+    naming the cell, not silent truncation; callers that really want wide
+    rows pass ``string_width=`` explicitly or raise the env cap."""
+    import os
+
+    try:
+        return int(os.environ.get("CYLON_TPU_MAX_STRING_WIDTH", "4096"))
+    except ValueError:
+        return 4096
+
+
+def _check_width(needed: int, explicit: Optional[int]) -> None:
+    cap = max_string_width()
+    if needed > cap and (explicit is None or needed > explicit):
+        raise CylonError(
+            Code.Invalid,
+            f"string cell of {needed} bytes exceeds the column width cap "
+            f"{cap} (HBM = capacity x width); pass string_width>={needed} "
+            f"or raise CYLON_TPU_MAX_STRING_WIDTH to ingest it")
 
 
 @jax.tree_util.register_dataclass
@@ -115,6 +140,59 @@ def _next_capacity(n: int, capacity: Optional[int]) -> int:
     return max(8, n)
 
 
+def _u_trailing_nul(values: np.ndarray) -> bool:
+    """True if any element of a U-dtype array ends in NUL codepoints (the
+    numpy U/S item-access convention strips them, so the vectorized
+    encoder would silently drop those characters)."""
+    n = len(values)
+    w = values.dtype.itemsize // 4
+    if n == 0 or w == 0:
+        return False
+    raw = np.ascontiguousarray(values).view(np.uint32).reshape(n, w)
+    nz = raw != 0
+    exact = np.where(nz.any(axis=1), w - np.argmax(nz[:, ::-1], axis=1), 0)
+    return bool((exact != np.char.str_len(values)).any())
+
+
+def _encode_rows_exact(values, missing):
+    """Per-row exact encoder (bytes kept verbatim, str utf-8-encoded) —
+    the fallback for inputs the vectorized path cannot represent."""
+    enc_list = [b"" if missing[i]
+                else (bytes(v) if isinstance(v, (bytes, bytearray))
+                      else str(v).encode("utf-8"))
+                for i, v in enumerate(values)]
+    w = max(1, max(map(len, enc_list)))
+    lens = np.array([len(b) for b in enc_list], np.int32)
+    return np.asarray(enc_list, f"S{w}"), missing, lens
+
+
+def _encode_strings(values: np.ndarray):
+    """(S-dtype encoded array, missing mask, exact lens or None) for a
+    U/S/object string array — vectorized (np.char) except bytes mixes and
+    values with trailing NULs, which take the exact per-row path.
+    ``lens=None`` means np.char.str_len is exact."""
+    n = len(values)
+    if n == 0:
+        return np.zeros((0,), "S1"), np.zeros((0,), bool), None
+    if values.dtype.kind == "S":
+        lens = np.array([len(v) for v in values], np.int32)  # NUL-exact
+        return np.ascontiguousarray(values), np.zeros((n,), bool), lens
+    if values.dtype.kind == "U":
+        if _u_trailing_nul(values):
+            return _encode_rows_exact(values, np.zeros((n,), bool))
+        return np.char.encode(values, "utf-8"), np.zeros((n,), bool), None
+    # object column: None/NaN are nulls (pandas missing-value convention)
+    import pandas as pd
+
+    missing = np.asarray(pd.isna(values), bool)
+    if any(isinstance(v, (bytes, bytearray))
+           or (isinstance(v, str) and v.endswith("\x00")) for v in values):
+        return _encode_rows_exact(values, missing)
+    filled = values.copy()
+    filled[missing] = ""
+    return np.char.encode(filled.astype("U"), "utf-8"), missing, None
+
+
 def from_numpy(values: np.ndarray, *, validity: Optional[np.ndarray] = None,
                capacity: Optional[int] = None,
                string_width: int = DEFAULT_STRING_WIDTH,
@@ -125,18 +203,16 @@ def from_numpy(values: np.ndarray, *, validity: Optional[np.ndarray] = None,
     n = len(values)
     cap = _next_capacity(n, capacity)
     if values.dtype.kind in ("U", "S", "O"):
-        # None / nan entries are nulls (pandas object-column missing values)
-        missing = np.array([v is None or (isinstance(v, float) and np.isnan(v))
-                            for v in values], bool) if n else np.zeros((0,), bool)
-        enc = [b"" if missing[i]
-               else (v if isinstance(v, bytes) else str(v).encode("utf-8"))
-               for i, v in enumerate(values)]
-        width = max([string_width] + [len(b) for b in enc]) if enc else string_width
+        enc, missing, exact_lens = _encode_strings(values)
+        obs = enc.dtype.itemsize if n else 0
+        _check_width(obs, string_width)
+        width = max(string_width, obs)
         mat = np.zeros((cap, width), np.uint8)
         lens = np.zeros((cap,), np.int32)
-        for i, b in enumerate(enc):
-            mat[i, : len(b)] = np.frombuffer(b, np.uint8)
-            lens[i] = len(b)
+        if n and obs:
+            mat[:n, :obs] = np.ascontiguousarray(enc).view(np.uint8).reshape(n, obs)
+            lens[:n] = (np.char.str_len(enc) if exact_lens is None
+                        else exact_lens)
         valid = np.zeros((cap,), bool)
         valid[:n] = ~missing if validity is None else validity[:n]
         dt = dtype or dtypes.string
@@ -207,13 +283,48 @@ def from_arrow(arr, *, capacity: Optional[int] = None,
     if arr.null_count:
         validity = np.asarray(arr.is_valid())
     if dtypes.is_string_like(dt):
-        py = arr.to_pylist()
-        enc = [b"" if v is None else (v if isinstance(v, bytes) else v.encode("utf-8"))
-               for v in py]
-        obj = np.empty((n,), object)
-        obj[:] = enc
-        return from_numpy(obj, validity=validity, capacity=capacity,
-                          string_width=string_width, dtype=dt)
+        import pyarrow as pa
+
+        cap = _next_capacity(n, capacity)
+        if pa.types.is_fixed_size_binary(arr.type):
+            w = arr.type.byte_width
+            data = np.frombuffer(arr.buffers()[1], np.uint8)
+            lo = arr.offset * w
+            offsets = np.arange(lo, lo + (n + 1) * w, w, np.int64)
+            lens_np = np.full((n,), w, np.int64)
+        else:
+            off_np = (np.int64 if pa.types.is_large_string(arr.type)
+                      or pa.types.is_large_binary(arr.type) else np.int32)
+            bufs = arr.buffers()
+            offsets = np.frombuffer(bufs[1], off_np)[
+                arr.offset: arr.offset + n + 1].astype(np.int64)
+            data = (np.frombuffer(bufs[2], np.uint8) if bufs[2] is not None
+                    else np.zeros((0,), np.uint8))
+            lens_np = np.diff(offsets)
+        # null slots hold Arrow-spec-undefined bytes: zero their lengths so
+        # the copy below skips them and the matrix rows stay zeroed (the
+        # module invariant every kernel relies on)
+        lens_np = np.where(validity[:n], lens_np, 0)
+        obs = int(lens_np.max()) if n else 0
+        _check_width(obs, string_width)
+        width = max(string_width, obs)
+        mat = np.zeros((cap, width), np.uint8)
+        total = int(lens_np.sum())
+        if total:
+            # vectorized ragged copy with O(total payload) temporaries (a
+            # full (n, obs) index matrix would dwarf the column itself)
+            starts = np.cumsum(lens_np) - lens_np
+            within = np.arange(total, dtype=np.int64) - np.repeat(starts,
+                                                                  lens_np)
+            src = np.repeat(offsets[:-1], lens_np) + within
+            dst_row = np.repeat(np.arange(n, dtype=np.int64), lens_np)
+            mat[: n].reshape(-1)[dst_row * width + within] = data[src]
+        lens = np.zeros((cap,), np.int32)
+        lens[:n] = lens_np
+        valid = np.zeros((cap,), bool)
+        valid[:n] = validity[:n]
+        return Column(jnp.asarray(mat), jnp.asarray(valid), jnp.asarray(lens),
+                      dt)
     if arr.null_count:
         # fill nulls BEFORE to_numpy: a nullable int64 otherwise detours
         # through float64 + NaN, silently rounding values above 2^53
@@ -235,6 +346,50 @@ def from_arrow(arr, *, capacity: Optional[int] = None,
     return from_numpy(np_vals, validity=validity, capacity=capacity, dtype=dt)
 
 
+def _bytes_rows(mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """object[n] of per-row ``bytes`` from a padded byte matrix —
+    vectorized via an S-dtype view (trailing NULs are padding by
+    construction); the rare row whose payload genuinely ends in NUL bytes
+    is fixed up individually."""
+    n, w = mat.shape
+    if n == 0 or w == 0:
+        return np.full((n,), b"", object)
+    sview = np.ascontiguousarray(mat).view(f"S{w}")[:, 0]
+    out = sview.astype(object)
+    mismatch = np.nonzero(np.char.str_len(sview) != lens)[0]
+    for i in mismatch:
+        out[i] = mat[i, : lens[i]].tobytes()
+    return out
+
+
+def _decode_rows(rows: np.ndarray, valid: np.ndarray,
+                 errors: str = "strict") -> np.ndarray:
+    """object[n] of decoded str (or raw bytes where utf-8 fails under
+    ``errors='strict'``); invalid rows become None.  Vectorized np.char
+    decode, with a per-row path only for invalid utf-8 or payloads ending
+    in NUL (the S-dtype round trip would strip them)."""
+    n = rows.shape[0]
+    out = np.empty((n,), object)
+    slow = (np.array([bool(v) and r.endswith(b"\x00")
+                      for v, r in zip(valid, rows)], bool)
+            if n else np.zeros((0,), bool))
+    fast = valid & ~slow
+    try:
+        if fast.any():
+            out[fast] = np.char.decode(rows[fast].astype("S"), "utf-8",
+                                       errors).astype(object)
+    except UnicodeDecodeError:
+        fast = np.zeros_like(valid)
+    for i in np.nonzero(valid & ~fast)[0]:
+        b = rows[i]
+        try:
+            out[i] = b.decode("utf-8", errors)
+        except UnicodeDecodeError:
+            out[i] = b
+    out[~valid] = None
+    return out
+
+
 def to_numpy(col: Column, row_count: int):
     """Export valid rows to host. Strings come back as an object array of
     ``bytes`` decoded to str when valid utf-8."""
@@ -243,17 +398,7 @@ def to_numpy(col: Column, row_count: int):
     if col.is_string:
         mat = np.asarray(col.data[:n])
         lens = np.asarray(col.lengths[:n])
-        out = np.empty((n,), object)
-        for i in range(n):
-            if not valid[i]:
-                out[i] = None
-                continue
-            b = mat[i, : lens[i]].tobytes()
-            try:
-                out[i] = b.decode("utf-8")
-            except UnicodeDecodeError:
-                out[i] = b
-        return out
+        return _decode_rows(_bytes_rows(mat, lens), valid)
     vals = np.asarray(col.data[:n])
     ndt = col.dtype.numpy_dtype()
     if vals.dtype != ndt and vals.dtype.kind in "iu" and np.dtype(ndt).kind in "iu":
@@ -277,9 +422,13 @@ def to_arrow(col: Column, row_count: int):
     if col.is_string:
         mat = np.asarray(col.data[:n])
         lens = np.asarray(col.lengths[:n])
-        vals = [mat[i, : lens[i]].tobytes() for i in range(n)]
+        rows = _bytes_rows(mat, lens)
         if col.dtype.type == Type.STRING:
-            vals = [v.decode("utf-8", errors="replace") for v in vals]
+            # errors='replace' never raises, so every valid row decodes
+            vals = _decode_rows(rows, valid, errors="replace")
+            vals[~valid] = ""  # placeholder under the null mask
+        else:
+            vals = rows
         return pa.array(vals, type=at, mask=mask)
     vals = np.asarray(col.data[:n])
     return pa.array(vals, type=at, mask=mask)

@@ -206,7 +206,10 @@ def hash_groupby(cols: Tuple[Column, ...], count,
                 raise TypeError(f"aggregation {op.name} unsupported on strings")
             vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid,
                                             cap, ddof)
-        validity = group_live & (cnts > 0)
+        if op in (AggOp.COUNT, AggOp.COUNTSUM, AggOp.NUNIQUE):
+            validity = group_live  # a count of zero values is a valid 0
+        else:
+            validity = group_live & (cnts > 0)
         vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
         out_cols.append(Column(vals, validity, None,
                                _agg_out_dtype(op, cols[col_idx].dtype)))
@@ -261,7 +264,10 @@ def pipeline_groupby(cols: Tuple[Column, ...], count,
                 raise TypeError(f"aggregation {op.name} unsupported on strings")
             vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid,
                                             cap, ddof)
-        validity = group_live & (cnts > 0)
+        if op in (AggOp.COUNT, AggOp.COUNTSUM, AggOp.NUNIQUE):
+            validity = group_live  # a count of zero values is a valid 0
+        else:
+            validity = group_live & (cnts > 0)
         vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
         out_cols.append(Column(vals, validity, None,
                                _agg_out_dtype(op, cols[col_idx].dtype)))
