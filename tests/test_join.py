@@ -139,3 +139,23 @@ def test_join_with_duplicates_both_sides(local_ctx):
     r = Table.from_pydict({"k": [1, 1], "y": [10.0, 20.0]}, ctx=local_ctx)
     j = l.join(r, on="k", how="inner")
     assert j.row_count == 6
+
+
+def test_join_capacity_cache_grows(local_ctx):
+    """Steady-state joins reuse the cached output capacity; a later join at
+    the same site whose result outgrows it must re-size, not truncate."""
+    cap = 16
+    small_l = Table.from_pydict({"k": [1, 2], "x": [1.0, 2.0]},
+                                ctx=local_ctx, capacity=cap)
+    small_r = Table.from_pydict({"k": [1, 2], "y": [1.0, 2.0]},
+                                ctx=local_ctx, capacity=cap)
+    j1 = small_l.join(small_r, on="k", how="inner")
+    assert j1.row_count == 2
+    # same site (same capacities/dtypes/keys), much larger fan-out
+    big_l = Table.from_pydict({"k": [7] * 10, "x": list(map(float, range(10)))},
+                              ctx=local_ctx, capacity=cap)
+    big_r = Table.from_pydict({"k": [7] * 10, "y": list(map(float, range(10)))},
+                              ctx=local_ctx, capacity=cap)
+    j2 = big_l.join(big_r, on="k", how="inner")
+    assert j2.row_count == 100
+    assert len(j2.to_pandas()) == 100
