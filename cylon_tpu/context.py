@@ -195,6 +195,17 @@ class CylonContext:
         return f"CylonContext({kind}, world_size={self.GetWorldSize()})"
 
 
+def ctx_cache(ctx: CylonContext, name: str) -> Dict:
+    """Per-context cache dict stored on the context object itself — dies
+    with the context (no id()-reuse aliasing, no global leak).  Used for
+    jitted shard programs and plan capacities keyed by this context."""
+    cache = getattr(ctx, name, None)
+    if cache is None:
+        cache = {}
+        setattr(ctx, name, cache)
+    return cache
+
+
 _default_local: Optional[CylonContext] = None
 
 

@@ -27,22 +27,24 @@ from . import collectives
 from . import partition as partition_mod
 from . import shuffle as shuffle_mod
 
-_PLAN_CACHE: Dict[tuple, object] = {}
 
 
 def _shard_map(ctx: CylonContext, fn, key: tuple, shapes_key: tuple,
                out_specs=None):
     from jax.sharding import PartitionSpec as P
 
-    cache_key = (key, id(ctx), shapes_key)
-    entry = _PLAN_CACHE.get(cache_key)
+    from ..context import ctx_cache
+
+    cache = ctx_cache(ctx, "_plan_cache")
+    cache_key = (key, shapes_key)
+    entry = cache.get(cache_key)
     if entry is None:
         spec = P(PARTITION_AXIS)
         entry = jax.jit(jax.shard_map(
             fn, mesh=ctx.mesh, in_specs=spec,
             out_specs=spec if out_specs is None else out_specs,
             check_vma=False))
-        _PLAN_CACHE[cache_key] = entry
+        cache[cache_key] = entry
     return entry
 
 

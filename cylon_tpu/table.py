@@ -31,7 +31,7 @@ from . import column as column_mod
 from . import dtypes
 from .column import Column
 from .config import JoinAlgorithm, JoinConfig, JoinType, SortOptions
-from .context import PARTITION_AXIS, CylonContext, default_context
+from .context import PARTITION_AXIS, CylonContext, ctx_cache, default_context
 from .ops import aggregates as agg_mod
 from .ops import compact as compact_mod
 from .ops import groupby as groupby_mod
@@ -757,7 +757,6 @@ class _RowEnv:
 # internals
 # ---------------------------------------------------------------------------
 
-_SHARD_FN_CACHE: Dict[tuple, object] = {}
 
 
 def _shard_wise(ctx: CylonContext, fn, *tables: Table, key: tuple):
@@ -770,17 +769,18 @@ def _shard_wise(ctx: CylonContext, fn, *tables: Table, key: tuple):
         return fn(*tables)
     from jax.sharding import PartitionSpec as P
 
-    cache_key = (key, id(ctx), t0.num_shards,
+    cache = ctx_cache(ctx, "_shard_fn_cache")
+    cache_key = (key, t0.num_shards,
                  tuple(t.capacity for t in tables),
                  tuple(t.names for t in tables),
                  tuple(tuple((c.dtype, c.data.shape[1:]) for c in t.columns)
                        for t in tables))
-    entry = _SHARD_FN_CACHE.get(cache_key)
+    entry = cache.get(cache_key)
     if entry is None:
         spec = P(PARTITION_AXIS)
         entry = jax.jit(jax.shard_map(fn, mesh=ctx.mesh, in_specs=spec,
                                       out_specs=spec, check_vma=False))
-        _SHARD_FN_CACHE[cache_key] = entry
+        cache[cache_key] = entry
     return entry(*tables)
 
 
@@ -860,9 +860,6 @@ def _cap_round(n: int) -> int:
     return -(-n // g) * g
 
 
-_JOIN_CAP_CACHE: Dict[tuple, int] = {}
-
-
 def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
     """Local join with adaptive output sizing.
 
@@ -880,7 +877,8 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
     jt = cfg.join_type
 
     algo = "hash" if cfg.algorithm == JoinAlgorithm.HASH else "sort"
-    site = ("join_cap", cfg.left_on, cfg.right_on, jt, algo, id(ctx),
+    cap_cache = ctx_cache(ctx, "_join_cap_cache")
+    site = ("join_cap", cfg.left_on, cfg.right_on, jt, algo,
             left.shard_capacity, right.shard_capacity,
             tuple(c.dtype for c in left.columns),
             tuple(c.dtype for c in right.columns))
@@ -897,12 +895,16 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
                                key=("join", cfg.left_on, cfg.right_on, jt,
                                     out_cap, algo))
 
-    cached = _JOIN_CAP_CACHE.get(site)
+    cached = cap_cache.get(site)
     if cached is not None:
         out = gather_at(cached)
-        hi = int(np.max(_host_row_counts(out))) if out.num_shards > 1 \
-            else int(out.row_counts[0])
+        hi = int(np.max(_host_row_counts(out)))
         if hi <= cached:
+            # shrink with hysteresis: one skewed join must not inflate
+            # this site (and everything sized off its result) forever
+            need = _cap_round(max(1, hi))
+            if need * 4 <= cached:
+                cap_cache[site] = need * 2
             return out
         # cached capacity too small: the gather truncated; fall through to
         # the exact two-pass and remember the larger size
@@ -921,7 +923,7 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
                              key=("join_count", cfg.left_on, cfg.right_on, jt,
                                   algo))
         out_cap = _cap_round(max(1, int(jnp.max(counts))))
-    _JOIN_CAP_CACHE[site] = out_cap
+    cap_cache[site] = out_cap
     return gather_at(out_cap)
 
 
