@@ -277,6 +277,11 @@ def from_arrow(arr, *, capacity: Optional[int] = None,
 
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
+    if pa.types.is_dictionary(arr.type):
+        # dictionary-encoded columns decode at the boundary: the device
+        # layout is the padded byte matrix either way, and every kernel
+        # (hash/sort/compare) operates on materialized values
+        arr = arr.dictionary_decode()
     dt = dtypes.from_arrow_type(arr.type)
     n = len(arr)
     validity = np.ones((n,), bool)

@@ -1,0 +1,53 @@
+"""Smoke the BASELINE example drivers at tiny scale (the reference's
+examples double as smoke tests, cpp/src/examples/*.cpp)."""
+import numpy as np
+import pyarrow as pa
+
+
+def test_join_csv_example():
+    from examples import join_csv
+
+    rec = join_csv.run(rows=5_000)
+    assert rec["out_rows"] > 0 and rec["rows_per_sec"] > 0
+
+
+def test_tpch_q1_example():
+    from examples import tpch_q1
+
+    rec = tpch_q1.run(sf=0.003)  # 18k lineitem rows; check=True inside
+    assert rec["groups"] == 6
+
+
+def test_tpch_q5_example():
+    from examples import tpch_q5
+
+    rec = tpch_q5.run(sf=0.004)
+    assert rec["nations"] >= 1
+
+
+def test_shuffle_example():
+    from examples import shuffle_bench
+
+    rec = shuffle_bench.run(rows=20_000, reps=1)
+    assert rec["rows_per_sec"] > 0
+
+
+def test_etl_to_flax_example():
+    from examples import etl_to_flax
+
+    rec = etl_to_flax.run(events=10_000, users=500, steps=5)
+    assert np.isfinite(rec["final_loss"])
+
+
+def test_dictionary_encoded_ingest(ctx4):
+    from cylon_tpu import Table
+    from cylon_tpu import column as colmod
+
+    d = pa.array(["a", "b", "a", None, "c"]).dictionary_encode()
+    c = colmod.from_arrow(d)
+    assert list(colmod.to_numpy(c, 5)) == ["a", "b", "a", None, "c"]
+    t = Table.from_arrow(pa.table({"k": d, "v": [1.0, 2.0, 3.0, 4.0, 5.0]}),
+                         ctx=ctx4)
+    g = t.groupby("k", {"v": ["sum"]})
+    got = g.to_pandas()
+    assert len(got) == 4  # a, b, c, null group
