@@ -1,0 +1,79 @@
+"""Strong/weak scaling driver (reference analog:
+cpp/src/experiments/run_dist_scaling.py:9-60, which sweeps world sizes and
+row counts over the distributed join).  Sweeps mesh sizes on the available
+devices and reports join / shuffle / groupby throughput per world size,
+one JSON line each.
+
+Usage: python -m examples.scaling [rows_per_shard] [strong|weak]
+  strong — total rows fixed at rows_per_shard * max_world, split across
+           however many shards the sweep step uses
+  weak   — rows_per_shard rows per shard at every world size
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from .util import emit, log, table_from_arrays
+
+
+def _sweep_worlds(max_devices: int):
+    w, out = 1, []
+    while w <= max_devices:
+        out.append(w)
+        w *= 2
+    return out
+
+
+def run(rows_per_shard: int = 1 << 17, mode: str = "weak") -> list:
+    import jax
+
+    from cylon_tpu import CylonContext, TPUConfig
+
+    ndev = len(jax.devices())
+    worlds = _sweep_worlds(ndev)
+    max_world = worlds[-1]
+    results = []
+    rng = np.random.default_rng(3)
+    for world in worlds:
+        rows = (rows_per_shard * world if mode == "weak"
+                else rows_per_shard * max_world)
+        ctx = (CylonContext.Init() if world == 1
+               else CylonContext.InitDistributed(TPUConfig(world_size=world)))
+        keys = max(rows, 1)
+        data_l = {"k": rng.integers(0, keys, rows).astype(np.int32),
+                  "a": rng.random(rows).astype(np.float32)}
+        data_r = {"k": rng.integers(0, keys, rows).astype(np.int32),
+                  "b": rng.random(rows).astype(np.float32)}
+        tl = table_from_arrays(data_l, ctx)
+        tr = table_from_arrays(data_r, ctx)
+
+        def timed(fn, reps=3):
+            fn()  # warm-up: compile + plan
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        t_shuffle = timed(lambda: tl.shuffle(["k"]).row_count)
+        t_join = timed(
+            lambda: tl.distributed_join(tr, on="k", how="inner").row_count)
+        t_groupby = timed(
+            lambda: tl.groupby("k", {"a": ["sum", "mean"]}).row_count)
+        results.append(emit(
+            "scaling", mode=mode, world=world, rows=rows,
+            shuffle_rows_per_sec=rows / t_shuffle,
+            join_rows_per_sec=2 * rows / t_join,
+            groupby_rows_per_sec=rows / t_groupby))
+    return results
+
+
+if __name__ == "__main__":
+    rps = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 17
+    mode = sys.argv[2] if len(sys.argv) > 2 else "weak"
+    log(f"scaling sweep: rows_per_shard={rps} mode={mode}")
+    run(rps, mode)

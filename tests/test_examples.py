@@ -39,6 +39,14 @@ def test_etl_to_flax_example():
     assert np.isfinite(rec["final_loss"])
 
 
+def test_scaling_example():
+    from examples import scaling
+
+    recs = scaling.run(rows_per_shard=4_000, mode="weak")
+    assert len(recs) >= 2  # world 1 and at least one distributed point
+    assert all(r["join_rows_per_sec"] > 0 for r in recs)
+
+
 def test_dictionary_encoded_ingest(ctx4):
     from cylon_tpu import Table
     from cylon_tpu import column as colmod
