@@ -254,12 +254,83 @@ class Table:
 
     def print(self, limit: int = 20) -> None:
         """CSV-ish row dump (reference: table.cpp Print/PrintToOStream)."""
+        print(self.to_string(limit))
+
+    def to_string(self, row_limit: int = 10) -> str:
+        """reference: pycylon Table.to_string (data/table.pyx:1602)."""
         d = self.to_pydict()
         names = list(d.keys())
-        print(",".join(names))
-        n = min(limit, self.row_count)
+        lines = [",".join(names)]
+        n = min(row_limit, self.row_count)
         for i in range(n):
+            lines.append(",".join(str(d[c][i]) for c in names))
+        return "\n".join(lines)
+
+    def show(self, row1: int = -1, row2: int = -1, col1: int = -1,
+             col2: int = -1) -> None:
+        """Print a row/column range; -1 bounds mean "to the end"
+        (reference: data/table.pyx:101 show)."""
+        if row1 == -1 and col1 == -1:
+            self.print()
+            return
+        t = self
+        if col1 != -1:
+            hi_c = len(self.columns) if col2 == -1 else col2
+            t = t.project(list(range(col1, hi_c)))
+        lo = max(row1, 0)
+        hi = t.row_count if row2 == -1 else min(row2, t.row_count)
+        d = t.to_pydict()
+        names = list(d.keys())
+        print(",".join(names))
+        for i in range(lo, hi):
             print(",".join(str(d[c][i]) for c in names))
+
+    @staticmethod
+    def from_list(col_names: Sequence[str], data_list: Sequence[Sequence],
+                  ctx: Optional[CylonContext] = None) -> "Table":
+        """Column-major lists (reference: data/table.pyx:811 from_list)."""
+        if len(col_names) != len(data_list):
+            raise CylonError(Code.Invalid,
+                             f"{len(col_names)} names for {len(data_list)} columns")
+        return Table.from_pydict(dict(zip(col_names, data_list)), ctx=ctx)
+
+    def clear(self) -> None:
+        """Drop all rows (reference: data/table.pyx:130 clear)."""
+        self.row_counts = jnp.zeros_like(self.row_counts)
+
+    def retain_memory(self, retain: bool) -> None:
+        """Parity no-op (reference: data/table.pyx:136 — controls whether
+        ops free their inputs; XLA arrays are freed by liveness, so there
+        is nothing to toggle)."""
+
+    def is_retain(self) -> bool:
+        return True
+
+    # -- index surface (reference: data/table.pyx:1977-2036) ----------
+    @property
+    def index(self):
+        from .index import RangeIndex
+
+        idx = getattr(self, "_index", None)
+        return idx if idx is not None else RangeIndex(0, self.row_count)
+
+    def set_index(self, key) -> None:
+        from .index import ColumnIndex, Index
+
+        self._index = key if isinstance(key, Index) else ColumnIndex(key)
+
+    def reset_index(self, key=None) -> None:
+        from .index import RangeIndex
+
+        self._index = RangeIndex(0, self.row_count)
+
+    def isna(self) -> "Table":
+        """alias of isnull (reference: data/table.pyx:1761)."""
+        return self.isnull()
+
+    def notna(self) -> "Table":
+        """alias of notnull (reference: data/table.pyx:1808)."""
+        return self.notnull()
 
     # ------------------------------------------------------------------
     # local relational ops (reference: table.hpp:241-417 free functions)
