@@ -75,18 +75,20 @@ def _measure(rows: int) -> float:
     cols_l = (colmod.from_numpy(lk), colmod.from_numpy(lv))
     cols_r = (colmod.from_numpy(rk), colmod.from_numpy(rv))
     count = jnp.asarray(rows, jnp.int32)
+    algo = os.environ.get("CYLON_BENCH_ALGO", "sort")  # sort|hash join kernel
 
     # size the join output once (exact count, like the reference's two-pass
     # builder Reserve); steady-state reps reuse the capacity
     m = int(join_mod.join_row_count(cols_l, count, cols_r, count,
-                                    (0,), (0,), JoinType.INNER))
+                                    (0,), (0,), JoinType.INNER, algo))
     out_cap = _cap_round(m)
-    _log(f"rows={rows} join_count={m} out_cap={out_cap}")
+    _log(f"rows={rows} join_count={m} out_cap={out_cap} algo={algo}")
 
     @jax.jit
     def pipeline(cl, cnt_l, cr, cnt_r):
         joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
-                                          (0,), (0,), JoinType.INNER, out_cap)
+                                          (0,), (0,), JoinType.INNER, out_cap,
+                                          algo)
         gcols, g = groupby_mod.hash_groupby(
             joined, jm, (0,), ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
         return gcols[1].data, gcols[2].data, g, jm

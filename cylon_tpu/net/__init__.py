@@ -231,8 +231,13 @@ class AllToAll(ChannelSendCallback, ChannelReceiveCallback):
     def __init__(self, ctx, sources: Sequence[int], targets: Sequence[int],
                  edge_id: int, callback: ReceiveCallback,
                  channel: Optional[Channel] = None,
-                 fabric: Optional[Dict] = None):
-        self.rank = ctx.GetRank()
+                 fabric: Optional[Dict] = None,
+                 rank: Optional[int] = None):
+        # ctx.GetRank() is the PROCESS rank (0 for every in-process mesh,
+        # the host id under jax.distributed); when composing one AllToAll
+        # per mesh shard in a single process, pass ``rank`` explicitly —
+        # shard index and process index are different id spaces
+        self.rank = ctx.GetRank() if rank is None else rank
         self.sources = list(sources)
         self.targets = list(targets)
         self.callback = callback
