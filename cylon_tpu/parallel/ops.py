@@ -277,12 +277,8 @@ def hash_partition(t, key_idx: Tuple[int, ...], num_partitions: int):
 
 def distributed_sort(t, by_idx: Tuple[int, ...], opts: SortOptions,
                      asc: Tuple[bool, ...] | None = None):
-    col = t.columns[by_idx[0]]
-    if col.is_string:
-        raise NotImplementedError(
-            "distributed_sort requires a numeric leading sort column "
-            "(matching the reference's numeric RangePartitionKernel, "
-            "arrow_partition_kernels.hpp:394-519)")
+    # string lead columns range-partition on their 4-byte prefix (beyond
+    # the reference, whose RangePartitionKernel is numeric only)
     shuffled = _shuffled(t, tuple(by_idx), "range", opts)
     if asc is None:
         asc = tuple([opts.ascending] * len(by_idx))

@@ -55,15 +55,30 @@ def hash_targets(cols: Sequence[Column], count, key_idx: Sequence[int],
 def range_targets(col: Column, count, world: int, *, num_bins: int,
                   num_samples: int, ascending: bool = True,
                   nulls_first: bool = True) -> jax.Array:
-    """Range-partition targets for one numeric sort column, globally
-    monotone: rows in shard t all order before rows in shard t+1.
+    """Range-partition targets for one sort column, globally monotone:
+    rows in shard t all order before rows in shard t+1.
+
+    Strings go BEYOND the reference (its RangePartitionKernel is numeric
+    only, arrow_partition_kernels.hpp:394-519): the leading 4 bytes pack
+    big-endian into a uint32 whose numeric order equals bytewise
+    lexicographic order, so the bin map stays monotone w.r.t. the true key
+    order — prefix collisions can only merge bins (worse balance), never
+    reorder them, and the post-shuffle local sort uses the full key.
 
     Collective footprint (identical in shape to the reference): pmin/pmax of
     the column extrema + one psum of the (num_bins,) sample histogram.
     """
     cap = col.data.shape[0]
     live = compact_mod.live_mask(cap, count) & col.validity
-    data = col.data
+    if col.is_string:
+        from ..ops import keys as keys_mod
+
+        # first word packs big-endian into the high bytes of a uint64;
+        # keep the top 32 bits (4 leading characters) as the bin key
+        word0 = keys_mod.pack_string_words(col.data[:, :4])[0]
+        data = (word0 >> jnp.uint64(32)).astype(jnp.uint32)
+    else:
+        data = col.data
     if data.dtype == jnp.bool_:
         data = data.astype(jnp.int32)
     # bin math precision only shapes load balance, never correctness: the

@@ -70,6 +70,24 @@ def test_distributed_sort(request, rng, world):
     assert sorted(got["a"]) == sorted(df["a"])
 
 
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_distributed_sort_string_lead(request, rng, world):
+    """Global sort on a STRING lead column — beyond the reference (its
+    RangePartitionKernel is numeric only): the range partitioner bins on
+    the 4-byte prefix; adversarial shared prefixes only hurt balance."""
+    ctx = request.getfixturevalue(f"ctx{world}")
+    n = 2000
+    words = np.array([f"w{rng.integers(0, 500):04d}" for _ in range(n)],
+                     object)
+    # shared-prefix block stressing bin merging
+    words[: n // 4] = np.array(
+        [f"aaaa{rng.integers(0, 99):02d}" for _ in range(n // 4)], object)
+    df = pd.DataFrame({"s": words, "v": rng.random(n)})
+    t = Table.from_pandas(df, ctx=ctx).distributed_sort("s")
+    got = t.to_pandas()["s"].tolist()
+    assert got == sorted(words)
+
+
 def test_distributed_sort_descending(request, rng, ctx4):
     df = pd.DataFrame({"a": rng.random(300)})
     t = Table.from_pandas(df, ctx=ctx4).distributed_sort(
