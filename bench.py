@@ -86,10 +86,13 @@ def _measure(rows: int) -> float:
 
     @jax.jit
     def pipeline(cl, cnt_l, cr, cnt_r):
+        # key_grouped inner join emits equal keys adjacent, so the group-by
+        # is the sort-free boundary-scan pipeline kernel — one big sort in
+        # the whole program instead of two
         joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
                                           (0,), (0,), JoinType.INNER, out_cap,
-                                          algo)
-        gcols, g = groupby_mod.hash_groupby(
+                                          algo, key_grouped=True)
+        gcols, g = groupby_mod.pipeline_groupby(
             joined, jm, (0,), ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
         return gcols[1].data, gcols[2].data, g, jm
 

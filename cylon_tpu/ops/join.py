@@ -117,17 +117,40 @@ def join_row_count(cols_l: Tuple[Column, ...], count_l,
 
 
 @partial(jax.jit, static_argnames=("left_on", "right_on", "join_type",
-                                   "out_capacity", "algorithm"))
+                                   "out_capacity", "algorithm",
+                                   "key_grouped"))
 def join_gather(cols_l: Tuple[Column, ...], count_l,
                 cols_r: Tuple[Column, ...], count_r,
                 left_on: Tuple[int, ...], right_on: Tuple[int, ...],
                 join_type: JoinType, out_capacity: int,
-                algorithm: str = "sort"):
+                algorithm: str = "sort", key_grouped: bool = False):
     """Produce gathered output columns (left columns ++ right columns) with
-    capacity ``out_capacity`` and the dynamic output row count."""
+    capacity ``out_capacity`` and the dynamic output row count.
+
+    ``key_grouped=True`` (INNER only): rows with equal join keys come out
+    adjacent, so a downstream group-by on the key can use the boundary-scan
+    pipeline kernel instead of re-sorting the whole output.  Grouping
+    reorders left rows by their match-range offset ``lo`` — for matched
+    rows ``lo`` uniquely identifies the key group under both algorithms
+    (distinct keys with right rows occupy distinct ranges), and only
+    matched rows emit in an inner join.  Costs one extra single-key int32
+    sort of the left side; saves the multi-operand lexsort of the (larger)
+    join output downstream."""
     lo, matches, perm_r, live_l, unmatched_r = _ranges(
         cols_l, count_l, cols_r, count_r, left_on, right_on, join_type,
         algorithm)
+    perm_l = None
+    if key_grouped:
+        if join_type != JoinType.INNER:
+            raise ValueError("key_grouped join output requires INNER")
+        cap_l = lo.shape[0]
+        order_key = jnp.where(live_l & (matches > 0), lo, _I32_MAX)
+        iota_l = jnp.arange(cap_l, dtype=jnp.int32)
+        _, perm_l = jax.lax.sort((order_key, iota_l), num_keys=1,
+                                 is_stable=True)
+        lo = jnp.take(lo, perm_l)
+        matches = jnp.take(matches, perm_l)
+        live_l = jnp.take(live_l, perm_l)
     emit, csum, total = _emission(matches, live_l, join_type)
 
     k = jnp.arange(out_capacity, dtype=jnp.int32)
@@ -144,7 +167,7 @@ def join_gather(cols_l: Tuple[Column, ...], count_l,
     in_main = k < total
     lvalid = in_main
     rvalid = in_main & matched
-    lidx = li
+    lidx = li if perm_l is None else jnp.take(perm_l, li)
     ridx = jnp.where(rvalid, ridx_inner, 0)
 
     out_count = total

@@ -1,0 +1,85 @@
+"""key_grouped join output: equal keys adjacent (pipeline-groupby-ready),
+identical multiset to the default order, both algorithms."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from cylon_tpu import column as colmod
+from cylon_tpu.config import JoinType
+from cylon_tpu.ops import groupby as gmod
+from cylon_tpu.ops import join as jmod
+from cylon_tpu.ops.groupby import AggOp
+
+
+def _cols(rng, n, keys):
+    k = colmod.from_numpy(rng.integers(0, keys, n).astype(np.int32))
+    v = colmod.from_numpy(rng.random(n))
+    return (k, v), jnp.asarray(n, jnp.int32)
+
+
+@pytest.mark.parametrize("algo", ["sort", "hash"])
+def test_key_grouped_inner_join(rng, algo):
+    (lk, lv), nl = _cols(rng, 700, 60)
+    (rk, rv), nr = _cols(rng, 500, 60)
+    cap = 1 << 14
+    cols, m = jmod.join_gather((lk, lv), nl, (rk, rv), nr, (0,), (0,),
+                               JoinType.INNER, cap, algo, key_grouped=True)
+    m = int(m)
+    keys = np.asarray(cols[0].data[:m])
+    # equal keys are adjacent: each key occupies one contiguous run
+    change = np.flatnonzero(np.diff(keys) != 0)
+    runs = len(change) + 1
+    assert runs == len(np.unique(keys))
+    # same multiset as the default-order join
+    cols0, m0 = jmod.join_gather((lk, lv), nl, (rk, rv), nr, (0,), (0,),
+                                 JoinType.INNER, cap, algo)
+    assert m == int(m0)
+    a = sorted(zip(np.asarray(cols[0].data[:m]).tolist(),
+                   np.asarray(cols[1].data[:m]).round(9).tolist(),
+                   np.asarray(cols[3].data[:m]).round(9).tolist()))
+    b = sorted(zip(np.asarray(cols0[0].data[:m]).tolist(),
+                   np.asarray(cols0[1].data[:m]).round(9).tolist(),
+                   np.asarray(cols0[3].data[:m]).round(9).tolist()))
+    assert a == b
+
+
+@pytest.mark.parametrize("algo", ["sort", "hash"])
+def test_key_grouped_join_pipeline_groupby(rng, algo):
+    """The bench pipeline shape: key_grouped join + boundary-scan groupby
+    must equal pandas merge+groupby exactly."""
+    n = 1200
+    lk = rng.integers(0, 150, n).astype(np.int32)
+    lv = rng.random(n)
+    rk = rng.integers(0, 150, n // 2).astype(np.int32)
+    rv = rng.random(n // 2)
+    cl = (colmod.from_numpy(lk), colmod.from_numpy(lv))
+    cr = (colmod.from_numpy(rk), colmod.from_numpy(rv))
+    cap = 1 << 15
+    cols, m = jmod.join_gather(cl, jnp.asarray(n, jnp.int32), cr,
+                               jnp.asarray(n // 2, jnp.int32), (0,), (0,),
+                               JoinType.INNER, cap, algo, key_grouped=True)
+    gcols, g = gmod.pipeline_groupby(
+        cols, m, (0,), ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
+    g = int(g)
+    exp = (pd.DataFrame({"k": lk, "a": lv})
+           .merge(pd.DataFrame({"k": rk, "b": rv}), on="k")
+           .groupby("k").agg(sum_a=("a", "sum"), mean_b=("b", "mean"))
+           .reset_index())
+    assert g == len(exp)
+    got = pd.DataFrame({
+        "k": np.asarray(gcols[0].data[:g]),
+        "sum_a": np.asarray(gcols[1].data[:g]),
+        "mean_b": np.asarray(gcols[2].data[:g]),
+    }).sort_values("k").reset_index(drop=True)
+    assert np.array_equal(got["k"], exp["k"])
+    np.testing.assert_allclose(got["sum_a"], exp["sum_a"], rtol=1e-9)
+    np.testing.assert_allclose(got["mean_b"], exp["mean_b"], rtol=1e-9)
+
+
+def test_key_grouped_rejects_outer(rng):
+    (lk, lv), nl = _cols(rng, 100, 10)
+    with pytest.raises(ValueError):
+        jmod.join_gather((lk, lv), nl, (lk, lv), nl, (0,), (0,),
+                         JoinType.LEFT, 1 << 10, "sort", key_grouped=True)
