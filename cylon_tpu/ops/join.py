@@ -14,9 +14,10 @@ build/probe; join_utils.cpp build_final_table).  Design:
    ``searchsorted`` yields its match range [lo, hi) — the merge step.
 3. The variable-size expansion (a left row with k matches emits k rows;
    outer variants emit null-filled singletons, the reference's -1 fills,
-   join.cpp:179-235) is realized as a static-capacity gather: output slot k
-   maps back to its (left row, match ordinal) via one searchsorted over the
-   emission prefix sum.
+   join.cpp:179-235) is realized as a static-capacity gather: each emitting
+   row scatters its index at its first output slot and a ``cummax`` forward
+   fill maps every slot back to its (left row, match ordinal) — one scan,
+   no sort.
 
 Everything is a static-shape XLA program; the only dynamic quantity is the
 returned row count.  ``join_row_count`` exposes the exact output size so the
@@ -154,11 +155,19 @@ def join_gather(cols_l: Tuple[Column, ...], count_l,
     emit, csum, total = _emission(matches, live_l, join_type)
 
     k = jnp.arange(out_capacity, dtype=jnp.int32)
-    # method='sort' rides the TPU sort unit instead of a 22-step binary
-    # search of gathers
-    li = jnp.searchsorted(csum, k, side="right", method="sort").astype(jnp.int32)
-    li = jnp.clip(li, 0, csum.shape[0] - 1)
-    base = csum[li] - emit[li]
+    # slot -> left row via scatter + cummax forward fill: each emitting row
+    # drops its index at its first output slot (bases are distinct and
+    # ascending), cummax fills the run — one scan instead of the
+    # searchsorted merge-sort over out_capacity + cap_l rows
+    cap_l = emit.shape[0]
+    iota_l = jnp.arange(cap_l, dtype=jnp.int32)
+    base_l = csum - emit
+    marker = jnp.full((out_capacity,), -1, jnp.int32)
+    marker = marker.at[jnp.where(emit > 0, base_l, out_capacity)].max(
+        iota_l, mode="drop")
+    li = jax.lax.cummax(marker)
+    li = jnp.clip(li, 0, cap_l - 1)
+    base = jnp.take(base_l, li)
     within = k - base
     matched = jnp.take(matches, li) > 0
     r_sorted_pos = jnp.take(lo, li) + within
