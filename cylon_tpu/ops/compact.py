@@ -16,15 +16,17 @@ import jax.numpy as jnp
 
 
 def compact_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """(perm, new_count): perm is a full-capacity permutation placing rows
-    where ``mask`` is True at the front, preserving order; new_count is the
-    number of kept rows (int32 scalar)."""
+    """(idx, new_count): the first ``new_count`` entries of ``idx`` are the
+    row indices where ``mask`` is True, in order (a cumsum-scatter — one
+    scan, no sort); entries past new_count are in-bounds filler that
+    callers must mask.  new_count is an int32 scalar."""
     cap = mask.shape[0]
-    key = (~mask).astype(jnp.uint8)
     iota = jnp.arange(cap, dtype=jnp.int32)
-    _, perm = jax.lax.sort((key, iota), num_keys=1, is_stable=True)
+    pos = jnp.cumsum(mask, dtype=jnp.int32) - 1
+    idx = jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(mask, pos, cap)].set(iota, mode="drop")
     new_count = jnp.sum(mask, dtype=jnp.int32)
-    return perm, new_count
+    return idx, new_count
 
 
 def live_mask(capacity: int, row_count) -> jax.Array:

@@ -9,7 +9,7 @@ lexsort — ops/keys.dense_group_ids), so the TPU-native formulation is:
     sum over segment g  =  csum[end_g] - csum[start_g]
 
 with segment spans recovered once per groupby from the group-boundary mask
-via a single mask-compaction sort (ops/compact.compact_indices).  This is
+via a cumsum-scatter compaction (ops/compact.compact_indices).  This is
 the replacement for the reference's per-group accumulator State streaming
 (cpp/src/cylon/groupby/hash_groupby.cpp:135-192 aggregate<op,T> and
 compute/aggregate_kernels.hpp KernelTraits): the prefix sum *is* the
