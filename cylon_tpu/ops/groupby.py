@@ -192,9 +192,9 @@ def hash_groupby(cols: Tuple[Column, ...], count,
     group_live = iota[:cap] < num_groups
 
     out_cols = []
-    for kc in key_cols:
-        sorted_col = kc.take(perm)
-        out_cols.append(sorted_col.take(leader, valid_mask=group_live))
+    leader_src = jnp.take(perm, leader)  # compose index gathers: one
+    for kc in key_cols:                  # column gather instead of two
+        out_cols.append(kc.take(leader_src, valid_mask=group_live))
 
     for col_idx, op in aggs:
         vcol = cols[col_idx].take(perm)

@@ -92,6 +92,38 @@ def test_narrow_groupby_jaxpr_is_64bit_free(rng, narrow_mode):
     assert not wide_arrays, f"64-bit arrays in narrow-mode groupby: {wide_arrays[:5]}"
 
 
+def test_narrow_bench_pipeline_jaxpr_is_64bit_free(rng, narrow_mode):
+    """The TPU bench shape (key_grouped join + pipeline groupby) must trace
+    64-bit-free in narrow mode — the compile/perf guarantee bench.py relies
+    on."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    from cylon_tpu import column as colmod
+    from cylon_tpu.config import JoinType
+    from cylon_tpu.ops import groupby as gmod
+    from cylon_tpu.ops import join as jmod
+
+    n = 1024
+    k = colmod.from_numpy(rng.integers(0, 200, n).astype(np.int32))
+    v = colmod.from_numpy(rng.random(n).astype(np.float32))
+
+    def pipeline(cl, c1, cr, c2):
+        joined, jm = jmod.join_gather(cl, c1, cr, c2, (0,), (0,),
+                                      JoinType.INNER, 4 * n, "sort",
+                                      key_grouped=True)
+        gcols, g = gmod.pipeline_groupby(
+            joined, jm, (0,), ((1, gmod.AggOp.SUM), (3, gmod.AggOp.MEAN)), 0)
+        return gcols[1].data, gcols[2].data, g
+
+    jaxpr = jax.make_jaxpr(pipeline)((k, v), jnp.asarray(n, jnp.int32),
+                                     (k, v), jnp.asarray(n, jnp.int32))
+    wide = re.findall(r"[iuf]64\[\d[^\]]*\]", str(jaxpr))
+    assert not wide, f"64-bit arrays in narrow bench pipeline: {wide[:5]}"
+
+
 def test_narrow_distributed_sort(ctx4, rng, narrow_mode):
     n = 3000
     df = pd.DataFrame({"a": rng.random(n), "b": rng.integers(0, 9, n)})
