@@ -219,10 +219,11 @@ def hash_groupby(cols: Tuple[Column, ...], count,
 def _nunique(vcol: Column, vvalid, gid, cap: int):
     """Distinct non-null values per group via a (gid, value) lexsort."""
     ops = [(~vvalid).astype(jnp.uint8), gid] + keys.column_operands(vcol, with_validity=False)
-    _, sorted_ops = keys.lexsort_indices(ops, cap)
+    perm, sorted_ops = keys.lexsort_indices(ops, cap)
     eq = keys.rows_equal_adjacent(sorted_ops)
-    svalid = sorted_ops[0] == 0
-    gsorted = sorted_ops[1]
+    # sorted_ops are packed words: recover fields through the permutation
+    svalid = jnp.take(vvalid, perm)
+    gsorted = jnp.take(gid, perm)
     new_distinct = (~eq) & svalid
     # i32 scatter-add, widened after: 64-bit scatters are ~8x slower on TPU
     cnt = jax.ops.segment_sum(new_distinct.astype(jnp.int32), gsorted, cap)
@@ -241,7 +242,7 @@ def pipeline_groupby(cols: Tuple[Column, ...], count,
     operands = [keys.padding_operand(cap, count)]
     for kc in key_cols:
         operands.extend(keys.column_operands(kc))
-    new_group = ~keys.rows_equal_adjacent(operands)
+    new_group = ~keys.rows_equal_adjacent(keys.pack_operands(operands))
     gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
     start, end = segments.segment_spans(new_group)
     iota = jnp.arange(cap, dtype=jnp.int32)

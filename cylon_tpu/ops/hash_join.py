@@ -55,7 +55,10 @@ def _row_eq(ops: Sequence[jax.Array], i_idx: jax.Array,
 
 def _combined_key_ops(cols_l, cols_r, left_on, right_on):
     """Concatenated (cap_l + cap_r) operand arrays comparable across
-    tables, plus the composite row hash of the concatenation."""
+    tables, plus the composite row hash of the concatenation.  Operands
+    are bit-packed (keys.pack_operands) so each equality check in the
+    build/probe loops costs one gather+compare per packed word instead of
+    one per field."""
     combined_cols = []
     ops: List[jax.Array] = []
     for ia, ib in zip(left_on, right_on):
@@ -63,7 +66,7 @@ def _combined_key_ops(cols_l, cols_r, left_on, right_on):
         combined_cols.append(c)
         ops.extend(keys.column_operands(c))
     h = hashing.hash_columns(combined_cols)
-    return ops, h
+    return keys.pack_operands(ops), h
 
 
 def _build(h_r: jax.Array, live_r: jax.Array, ops, cap_l: int, cap_r: int,

@@ -96,11 +96,84 @@ def _invert_operand(x: jax.Array) -> jax.Array:
     return ~x.astype(jnp.uint8)
 
 
+_UINT_OF = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def _ordered_unsigned(x: jax.Array) -> Tuple[jax.Array, int]:
+    """(unsigned array, bit width) in an order-preserving encoding: signed
+    ints bias by the sign bit, floats use the total-order bit trick (NaNs
+    sort to the extremes, matching lax.sort's totalorder comparator)."""
+    dt = x.dtype
+    if dt == jnp.bool_:
+        return x.astype(jnp.uint8), 8
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        return x, dt.itemsize * 8
+    w = dt.itemsize * 8
+    u = _UINT_OF[dt.itemsize]
+    if jnp.issubdtype(dt, jnp.floating):
+        # canonicalize before the bitcast so equality matches value
+        # semantics: -0.0 groups with +0.0, and every NaN payload collapses
+        # to one key (pandas-style: NaNs form a single group)
+        x = jnp.where(x == 0, jnp.zeros((), dt), x)
+        x = jnp.where(jnp.isnan(x), jnp.full((), jnp.nan, dt), x)
+        bits = jax.lax.bitcast_convert_type(x, u)
+        top = jnp.asarray(1 << (w - 1), u)
+        neg = (bits >> jnp.asarray(w - 1, u)) == 1
+        return jnp.where(neg, ~bits, bits | top), w
+    bits = jax.lax.bitcast_convert_type(x, u)
+    top = jnp.asarray(1 << (w - 1), u)
+    if jnp.issubdtype(dt, jnp.signedinteger):
+        return bits ^ top, w
+    raise TypeError(f"unsupported operand dtype {dt}")
+
+
+def pack_operands(operands: Sequence[jax.Array]) -> List[jax.Array]:
+    """Greedily bit-pack the operands' order-preserving unsigned encodings
+    into uint32 words (fields MSB-first within a word): lexicographic
+    order AND rowwise equality over the packed words equal those over the
+    original operand list, while the sort carries fewer arrays and
+    comparisons.  E.g. [pad u8, validity u8] packs to one u16-in-u32 word,
+    so a single-i32-key sort carries 2 operands instead of 3.  64-bit
+    fields (i64/f64 data, packed string words) pass through as standalone
+    u64 operands — the 32-bit word target keeps narrow-mode programs free
+    of emulated 64-bit arrays for 32-bit data."""
+    out: List[jax.Array] = []
+    cur = None
+    used = 0
+
+    def flush():
+        nonlocal cur, used
+        if cur is not None:
+            out.append(cur)
+        cur, used = None, 0
+
+    for op in operands:
+        bits, w = _ordered_unsigned(op)
+        if w >= 64:
+            flush()
+            out.append(bits)
+            continue
+        b32 = bits.astype(jnp.uint32)
+        if cur is None or used + w > 32:
+            flush()
+            cur, used = b32, w
+        else:
+            cur = (cur << jnp.uint32(w)) | b32
+            used += w
+    flush()
+    return out
+
+
 def lexsort_indices(operands: Sequence[jax.Array], capacity: int) -> Tuple[jax.Array, List[jax.Array]]:
-    """Stable lexicographic argsort. Returns (permutation, sorted_operands)."""
+    """Stable lexicographic argsort over bit-packed operands.  Returns
+    (permutation, sorted PACKED operands) — the packed words support
+    adjacency/equality tests (rows_equal_adjacent, dense_group_ids) but
+    not per-field access; gather original fields through the permutation
+    when field values are needed."""
+    packed = pack_operands(operands)
     iota = jnp.arange(capacity, dtype=jnp.int32)
-    sorted_all = jax.lax.sort(tuple(operands) + (iota,),
-                              num_keys=len(operands), is_stable=True)
+    sorted_all = jax.lax.sort(tuple(packed) + (iota,),
+                              num_keys=len(packed), is_stable=True)
     perm = sorted_all[-1]
     return perm, list(sorted_all[:-1])
 

@@ -134,3 +134,32 @@ def test_local_pipeline_groupby_table(local_ctx, rng):
     ref = (pd.DataFrame({"k": k, "v": v}).groupby("k")
            .agg(min_v=("v", "min"), max_v=("v", "max")).reset_index())
     assert_rows_equal(out, ref, ndigits=9)
+
+
+def test_float_zero_and_nan_key_semantics(local_ctx):
+    """-0.0 groups with +0.0 and all NaN payloads form ONE group (pandas
+    dropna=False semantics) in every sort-based kernel."""
+    import pandas as pd
+    from cylon_tpu import Table
+
+    k = np.array([0.0, -0.0, 1.0, np.nan, np.nan, 1.0])
+    v = np.arange(6, dtype=np.float64)
+    # NaN keys arrive as valid values, not nulls, to exercise raw-NaN keys
+    t = Table.from_pydict({"k": k, "v": v}, ctx=local_ctx)
+    from cylon_tpu import column as colmod
+
+    kcol = colmod.from_numpy(k, validity=np.ones(6, bool))
+    vcol = colmod.from_numpy(v)
+    from cylon_tpu.ops import groupby as gmod
+    import jax.numpy as jnp
+
+    cols, g = gmod.hash_groupby((kcol, vcol), jnp.asarray(6, jnp.int32),
+                                (0,), ((1, gmod.AggOp.COUNT),), 0)
+    assert int(g) == 3  # {0.0/-0.0}, {1.0}, {NaN}
+    counts = sorted(np.asarray(cols[1].data[:3]).tolist())
+    assert counts == [2, 2, 2]
+
+    from cylon_tpu.ops import unique as umod
+
+    ucols, m = umod.unique((kcol,), jnp.asarray(6, jnp.int32), (0,), "first")
+    assert int(m) == 3
