@@ -133,6 +133,14 @@ def _worker(backend: str, skip: int = 0) -> int:
         # (and possibly hang on) the tunnel
         jax.config.update("jax_platforms", "cpu")
 
+    try:  # persistent compile cache: the 67M-row pipeline compile is slow
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                       ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception as e:
+        _log(f"compile cache unavailable: {e}")
+
     plat = jax.devices()[0].platform
     _log(f"worker backend={plat} devices={len(jax.devices())}")
     if backend == "tpu" and plat not in ("tpu", "axon"):
