@@ -29,6 +29,22 @@ def compact_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return idx, new_count
 
 
+def partition_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(perm, true_count): a full stable partition permutation — mask-True
+    row indices first (in order), then every mask-False index (in order).
+    Unlike ``compact_indices`` the tail is the real False rows, so ``perm``
+    is a permutation of [0, n) usable wherever each row must appear exactly
+    once (e.g. reordering a table without dropping rows)."""
+    cap = mask.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    nt = jnp.sum(mask, dtype=jnp.int32)
+    ct = jnp.cumsum(mask, dtype=jnp.int32)
+    cf = iota + 1 - ct  # cumsum of ~mask without a second scan
+    dest = jnp.where(mask, ct - 1, nt + cf - 1)
+    perm = jnp.zeros((cap,), jnp.int32).at[dest].set(iota)
+    return perm, nt
+
+
 def live_mask(capacity: int, row_count) -> jax.Array:
     """bool[capacity]: True for rows below the dynamic row count."""
     return jnp.arange(capacity, dtype=jnp.int32) < row_count

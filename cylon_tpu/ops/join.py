@@ -6,12 +6,13 @@ TPU-native replacement for the reference's local join layer
 build/probe; join_utils.cpp build_final_table).  Design:
 
 1. One fused multi-key ``lax.sort`` over the union of both tables' key rows
-   assigns a dense int32 group id per distinct key
-   (ops/common.combined_group_ids) — this subsumes both the comparator
-   machinery and the hash table, works for any column type mix, and has no
+   — the kernel's ONLY sort — subsumes both the comparator machinery and
+   the hash table, works for any column type mix, and has no
    data-dependent control flow.
-2. Right rows are sorted by group id; per left row a vectorized
-   ``searchsorted`` yields its match range [lo, hi) — the merge step.
+2. Per-left-row match ranges [lo, lo+matches) into the key-ordered right
+   side are prefix arithmetic over the sorted order (cumsum + segmented
+   broadcasts); the key-ordered right permutation is a compaction of the
+   combined sort's right entries — the merge step without a second sort.
 3. The variable-size expansion (a left row with k matches emits k rows;
    outer variants emit null-filled singletons, the reference's -1 fills,
    join.cpp:179-235) is realized as a static-capacity gather: each emitting
@@ -33,53 +34,100 @@ import jax.numpy as jnp
 
 from ..column import Column
 from ..config import JoinType
-from . import common, compact
+from . import common, compact, keys
 
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
 
+def _suffix_cummin(x: jax.Array) -> jax.Array:
+    return jax.lax.cummin(x, reverse=True)
+
+
+def _run_extents(lr: jax.Array, new_group: jax.Array, is_run_end: jax.Array,
+                 big) -> Tuple[jax.Array, jax.Array]:
+    """Per sorted position: (# True ``lr`` rows before this position's run,
+    # True ``lr`` rows inside the run).  One cumsum + one cummax + one
+    suffix cummin — no scatters."""
+    incl = jnp.cumsum(lr.astype(jnp.int32))
+    excl = incl - lr.astype(jnp.int32)
+    start = jax.lax.cummax(jnp.where(new_group, excl, jnp.int32(-1)))
+    end = _suffix_cummin(jnp.where(is_run_end, incl, big))
+    return start, end - start
+
+
 def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
                   join_type: JoinType):
-    """Compute per-left-row match ranges into a gid-sorted right table.
+    """Compute per-left-row match ranges into a gid-ordered right table.
 
-    Both sides share dense group ids from one combined lexsort, so the match
-    range of a left row is pure integer arithmetic: a per-gid histogram of
-    live right rows (one int32 scatter-add — 64-bit scatters and
-    searchsorted binary searches both profile ~10x slower on TPU) prefix-
-    summed into start offsets.  Returns
-    (lo, matches, perm_r, live_l, unmatched_right_mask).
+    One fused multi-key ``lax.sort`` over the union of both tables' key rows
+    is the ONLY sort in the kernel (the reference's hash build/probe,
+    join.cpp:448-513, and its comparator sorts, join.cpp:78-434, both
+    collapse into it).  Everything else is prefix arithmetic over the
+    sorted order:
+
+    - a left row's match range [lo, lo+matches) = (# live right rows before
+      its key run, # live right rows inside it) — cumsum + segmented
+      broadcast (cummax of run-start values / suffix-cummin of run-end
+      values), replacing per-gid histogram scatter-adds;
+    - the gid-ordered right permutation falls out of the combined sort by
+      compacting its right-side entries (cumsum-scatter) — no second sort;
+    - per-original-row results come back through one scatter along the sort
+      permutation.
+
+    Returns (lo, matches, perm_r, live_l, unmatched_right_mask,
+    left_key_order) where left_key_order lists left row ids in key order
+    (used by key_grouped join output to avoid another sort).
     """
     cap_l = cols_l[0].data.shape[0]
     cap_r = cols_r[0].data.shape[0]
-    gid_l, gid_r, *_ = common.combined_group_ids(
-        cols_l, count_l, cols_r, count_r, left_on, right_on)
+    n = cap_l + cap_r
+
+    operands = [common.two_table_padding(cap_l, count_l, cap_r, count_r)]
+    for ia, ib in zip(left_on, right_on):
+        combined = common.concat_columns(cols_l[ia], cols_r[ib])
+        operands.extend(keys.column_operands(combined))
+    perm, sorted_ops = keys.lexsort_indices(operands, n)
+    new_group = ~keys.rows_equal_adjacent(sorted_ops)
+    is_run_end = jnp.concatenate([new_group[1:], jnp.ones((1,), bool)])
+
+    pos = jnp.arange(n, dtype=jnp.int32)
+    live_sorted = pos < (count_l + count_r)  # padding flag sorts last
+    is_right = perm >= cap_l
+    big = jnp.int32(n + 1)
+
+    # live right rows before / inside each position's key run
+    lo_sorted, matches_sorted = _run_extents(
+        is_right & live_sorted, new_group, is_run_end, big)
+
+    fields = [lo_sorted, matches_sorted]
+    if join_type in (JoinType.RIGHT, JoinType.FULL_OUTER):
+        _, left_in_run = _run_extents(
+            (~is_right) & live_sorted, new_group, is_run_end, big)
+        fields.append((left_in_run == 0).astype(jnp.int32))
+
+    # one scatter maps per-sorted-position results back to original rows
+    back = jnp.zeros((n, len(fields)), jnp.int32).at[perm].set(
+        jnp.stack(fields, axis=1))
 
     live_l = jnp.arange(cap_l, dtype=jnp.int32) < count_l
     live_r = jnp.arange(cap_r, dtype=jnp.int32) < count_r
-    n_gid = cap_l + cap_r
-
-    # per-gid live right-row histogram -> start offsets in gid-sorted order
-    ones_r = live_r.astype(jnp.int32)
-    counts_r = jnp.zeros((n_gid,), jnp.int32).at[gid_r].add(ones_r)
-    csum_r = jnp.cumsum(counts_r, dtype=jnp.int32)
-    rstart = jnp.concatenate([jnp.zeros((1,), jnp.int32), csum_r[:-1]])
-    lo = jnp.take(rstart, gid_l)
-    matches = jnp.where(live_l, jnp.take(counts_r, gid_l), 0)
-
-    # right rows ordered by gid, live rows first (padding exiled to +inf);
-    # rstart[g] indexes into exactly this order
-    rkey = jnp.where(live_r, gid_r, _I32_MAX)
-    iota_r = jnp.arange(cap_r, dtype=jnp.int32)
-    _, perm_r = jax.lax.sort((rkey, iota_r), num_keys=1, is_stable=True)
-
-    # right rows with no left partner — only RIGHT/FULL_OUTER pay for it
+    lo = back[:cap_l, 0]
+    matches = jnp.where(live_l, back[:cap_l, 1], 0)
     if join_type in (JoinType.RIGHT, JoinType.FULL_OUTER):
-        counts_l = jnp.zeros((n_gid,), jnp.int32).at[gid_l].add(
-            live_l.astype(jnp.int32))
-        unmatched_r = live_r & (jnp.take(counts_l, gid_r) == 0)
+        unmatched_r = live_r & (back[cap_l:, 2] == 1)
     else:
         unmatched_r = jnp.zeros((cap_r,), bool)
-    return lo, matches, perm_r, live_l, unmatched_r
+
+    # gid-ordered right permutation: compact the combined sort's right-side
+    # entries (live rows first by key then original index, padding last —
+    # exactly the order ``lo`` indexes into)
+    idx_r, _ = compact.compact_indices(is_right)
+    perm_r = jnp.take(perm, idx_r[:cap_r]) - cap_l
+
+    # left row ids in key order, for key_grouped output
+    idx_l, _ = compact.compact_indices(~is_right)
+    left_key_order = jnp.take(perm, idx_l[:cap_l])
+    return lo, matches, perm_r, live_l, unmatched_r, left_key_order
 
 
 def _emission(matches, live_l, join_type: JoinType):
@@ -96,7 +144,8 @@ def _ranges(cols_l, count_l, cols_r, count_r, left_on, right_on, join_type,
         from . import hash_join
 
         return hash_join.match_ranges_hash(
-            cols_l, count_l, cols_r, count_r, left_on, right_on, join_type)
+            cols_l, count_l, cols_r, count_r, left_on, right_on,
+            join_type) + (None,)
     return _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
                          join_type)
 
@@ -108,7 +157,7 @@ def join_row_count(cols_l: Tuple[Column, ...], count_l,
                    left_on: Tuple[int, ...], right_on: Tuple[int, ...],
                    join_type: JoinType, algorithm: str = "sort"):
     """Exact output row count of the join (device scalar)."""
-    lo, matches, perm_r, live_l, unmatched_r = _ranges(
+    lo, matches, perm_r, live_l, unmatched_r, _ = _ranges(
         cols_l, count_l, cols_r, count_r, left_on, right_on, join_type,
         algorithm)
     _, _, total = _emission(matches, live_l, join_type)
@@ -131,13 +180,14 @@ def join_gather(cols_l: Tuple[Column, ...], count_l,
     ``key_grouped=True`` (INNER only): rows with equal join keys come out
     adjacent, so a downstream group-by on the key can use the boundary-scan
     pipeline kernel instead of re-sorting the whole output.  Grouping
-    reorders left rows by their match-range offset ``lo`` — for matched
-    rows ``lo`` uniquely identifies the key group under both algorithms
-    (distinct keys with right rows occupy distinct ranges), and only
-    matched rows emit in an inner join.  Costs one extra single-key int32
-    sort of the left side; saves the multi-operand lexsort of the (larger)
-    join output downstream."""
-    lo, matches, perm_r, live_l, unmatched_r = _ranges(
+    reorders left rows into key order — on the sort path that order falls
+    out of the combined lexsort (left_key_order) and matched rows are
+    front-packed with one stable partition (no extra sort); the hash path
+    has no key-sorted order, so it sorts left rows by their match-range
+    offset ``lo``, which uniquely identifies the key group for matched
+    rows.  Either way the multi-operand lexsort of the (larger) join
+    output downstream is saved."""
+    lo, matches, perm_r, live_l, unmatched_r, left_key_order = _ranges(
         cols_l, count_l, cols_r, count_r, left_on, right_on, join_type,
         algorithm)
     perm_l = None
@@ -145,10 +195,15 @@ def join_gather(cols_l: Tuple[Column, ...], count_l,
         if join_type != JoinType.INNER:
             raise ValueError("key_grouped join output requires INNER")
         cap_l = lo.shape[0]
-        order_key = jnp.where(live_l & (matches > 0), lo, _I32_MAX)
-        iota_l = jnp.arange(cap_l, dtype=jnp.int32)
-        _, perm_l = jax.lax.sort((order_key, iota_l), num_keys=1,
-                                 is_stable=True)
+        if left_key_order is None:  # hash path: order by match-range offset
+            order_key = jnp.where(live_l & (matches > 0), lo, _I32_MAX)
+            iota_l = jnp.arange(cap_l, dtype=jnp.int32)
+            _, perm_l = jax.lax.sort((order_key, iota_l), num_keys=1,
+                                     is_stable=True)
+        else:  # sort path: key order is known; partition matched to front
+            lm = jnp.take(live_l & (matches > 0), left_key_order)
+            part, _ = compact.partition_indices(lm)
+            perm_l = jnp.take(left_key_order, part)
         lo = jnp.take(lo, perm_l)
         matches = jnp.take(matches, perm_l)
         live_l = jnp.take(live_l, perm_l)
