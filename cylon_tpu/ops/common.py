@@ -49,20 +49,23 @@ def two_table_padding(cap_a: int, count_a, cap_b: int, count_b) -> jax.Array:
     return jnp.where(in_a, pad_a, pad_b).astype(jnp.uint8)
 
 
-def combined_group_ids(cols_a: Sequence[Column], count_a,
-                       cols_b: Sequence[Column], count_b,
-                       key_a: Sequence[int], key_b: Sequence[int]):
-    """Lexsort the union of two tables' key rows and assign dense group ids.
+def combined_sorted_runs(cols_a: Sequence[Column], count_a,
+                         cols_b: Sequence[Column], count_b,
+                         key_a: Sequence[int], key_b: Sequence[int]):
+    """Lexsort the union of two tables' key rows and mark the key runs.
 
     This is the TPU replacement for the reference's hash-table row matching
     (HashJoinKernel build/probe, arrow/arrow_hash_kernels.hpp:33-215, and the
     RowComparator hash-sets of the set ops, table.cpp:522-734): after one
     fused multi-key sort of all rows from both tables, rows with equal keys
-    share a dense int32 id, turning every equality problem downstream into
-    integer comparisons.
+    are one contiguous run, turning every equality problem downstream into
+    prefix arithmetic over the sorted order (segments.run_extents) — no
+    group-id arrays, no scatters.
 
-    Returns (gid_a[cap_a], gid_b[cap_b], perm, sorted_ops, num_all_groups).
-    Padding rows from either table share the final (largest) group id.
+    Returns (perm, sorted_ops, new_group, is_run_end, live_sorted) over the
+    cap_a + cap_b sorted positions; ``perm[p] < cap_a`` identifies table-A
+    rows, and padding rows from either table sort last (the padding flag is
+    the primary sort operand), so ``live_sorted`` is a prefix mask.
     """
     cap_a = cols_a[0].data.shape[0]
     cap_b = cols_b[0].data.shape[0]
@@ -72,6 +75,8 @@ def combined_group_ids(cols_a: Sequence[Column], count_a,
         combined = concat_columns(cols_a[ia], cols_b[ib])
         operands.extend(keys.column_operands(combined))
     perm, sorted_ops = keys.lexsort_indices(operands, n)
-    gid_sorted, num_groups = keys.dense_group_ids(sorted_ops)
-    gid = jnp.zeros((n,), jnp.int32).at[perm].set(gid_sorted)
-    return gid[:cap_a], gid[cap_a:], perm, sorted_ops, num_groups
+    new_group = ~keys.rows_equal_adjacent(sorted_ops)
+    is_run_end = jnp.concatenate([new_group[1:], jnp.ones((1,), bool)])
+    pos = jnp.arange(n, dtype=jnp.int32)
+    live_sorted = pos < (count_a + count_b)
+    return perm, sorted_ops, new_group, is_run_end, live_sorted

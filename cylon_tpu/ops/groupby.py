@@ -101,10 +101,10 @@ def _agg_out_dtype(op: AggOp, dt: dtypes.DataType):
 
 
 def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
-                       ddof: int):
+                       ddof: int, spans=None):
     """One masked segment reduction; returns (values, validity_counts).
 
-    All reductions are ``jax.ops.segment_*`` scatters with 32-bit operands
+    Reductions are ``jax.ops.segment_*`` scatters with 32-bit operands
     wherever the semantics allow (counts accumulate i32 and widen after;
     f32 sums stay f32, matching the reference's KernelTraits accumulator of
     the input type) — 64-bit scatters profile ~8x slower on TPU, and the
@@ -112,8 +112,24 @@ def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
     XLA TPU backend whenever several 64-bit prefix programs share one
     multi-aggregation fusion.  Only ops whose semantics require double
     accumulation (MEAN/VAR/STDDEV/SUMSQ, f64/int64 SUM) pay the 64-bit
-    scatter."""
-    cnt32 = jax.ops.segment_sum(valid.astype(jnp.int32), gid, num_segments)
+    scatter.
+
+    ``spans``: optional (start, end) per-segment row spans when rows are
+    already ordered by ``gid`` (always true here — gids come from a sort or
+    key-adjacent input).  In narrow mode, validity counts then use an exact
+    i32 cumsum + boundary gather instead of a scatter (the cumsum peaks at
+    the shard's physical row count, always an i32-safe quantity).  Value
+    sums — including COUNTSUM, whose partial counts can represent far more
+    rows than the shard holds — keep the per-segment scatter-add: a global
+    prefix sum would overflow i32 for int data and lose precision for
+    f32."""
+    sorted_counts = spans is not None and precision.narrow()
+    if sorted_counts:
+        start, end = spans
+        cnt32 = segments.segment_sum_sorted(valid.astype(jnp.int32), start,
+                                            end, jnp.int32)
+    else:
+        cnt32 = jax.ops.segment_sum(valid.astype(jnp.int32), gid, num_segments)
     cnt = cnt32 if precision.narrow() else cnt32.astype(jnp.int64)
     if op == AggOp.COUNT:
         return cnt, cnt
@@ -205,7 +221,7 @@ def hash_groupby(cols: Tuple[Column, ...], count,
             if vcol.is_string:
                 raise TypeError(f"aggregation {op.name} unsupported on strings")
             vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid,
-                                            cap, ddof)
+                                            cap, ddof, spans=(start, end))
         if op in (AggOp.COUNT, AggOp.COUNTSUM, AggOp.NUNIQUE):
             validity = group_live  # a count of zero values is a valid 0
         else:
@@ -264,7 +280,7 @@ def pipeline_groupby(cols: Tuple[Column, ...], count,
             if vcol.is_string:
                 raise TypeError(f"aggregation {op.name} unsupported on strings")
             vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid,
-                                            cap, ddof)
+                                            cap, ddof, spans=(start, end))
         if op in (AggOp.COUNT, AggOp.COUNTSUM, AggOp.NUNIQUE):
             validity = group_live  # a count of zero values is a valid 0
         else:

@@ -34,25 +34,9 @@ import jax.numpy as jnp
 
 from ..column import Column
 from ..config import JoinType
-from . import common, compact, keys
+from . import common, compact, segments
 
 _I32_MAX = jnp.iinfo(jnp.int32).max
-
-
-def _suffix_cummin(x: jax.Array) -> jax.Array:
-    return jax.lax.cummin(x, reverse=True)
-
-
-def _run_extents(lr: jax.Array, new_group: jax.Array, is_run_end: jax.Array,
-                 big) -> Tuple[jax.Array, jax.Array]:
-    """Per sorted position: (# True ``lr`` rows before this position's run,
-    # True ``lr`` rows inside the run).  One cumsum + one cummax + one
-    suffix cummin — no scatters."""
-    incl = jnp.cumsum(lr.astype(jnp.int32))
-    excl = incl - lr.astype(jnp.int32)
-    start = jax.lax.cummax(jnp.where(new_group, excl, jnp.int32(-1)))
-    end = _suffix_cummin(jnp.where(is_run_end, incl, big))
-    return start, end - start
 
 
 def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
@@ -81,28 +65,18 @@ def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
     cap_l = cols_l[0].data.shape[0]
     cap_r = cols_r[0].data.shape[0]
     n = cap_l + cap_r
-
-    operands = [common.two_table_padding(cap_l, count_l, cap_r, count_r)]
-    for ia, ib in zip(left_on, right_on):
-        combined = common.concat_columns(cols_l[ia], cols_r[ib])
-        operands.extend(keys.column_operands(combined))
-    perm, sorted_ops = keys.lexsort_indices(operands, n)
-    new_group = ~keys.rows_equal_adjacent(sorted_ops)
-    is_run_end = jnp.concatenate([new_group[1:], jnp.ones((1,), bool)])
-
-    pos = jnp.arange(n, dtype=jnp.int32)
-    live_sorted = pos < (count_l + count_r)  # padding flag sorts last
+    perm, _, new_group, is_run_end, live_sorted = common.combined_sorted_runs(
+        cols_l, count_l, cols_r, count_r, left_on, right_on)
     is_right = perm >= cap_l
-    big = jnp.int32(n + 1)
 
     # live right rows before / inside each position's key run
-    lo_sorted, matches_sorted = _run_extents(
-        is_right & live_sorted, new_group, is_run_end, big)
+    lo_sorted, matches_sorted = segments.run_extents(
+        is_right & live_sorted, new_group, is_run_end)
 
     fields = [lo_sorted, matches_sorted]
     if join_type in (JoinType.RIGHT, JoinType.FULL_OUTER):
-        _, left_in_run = _run_extents(
-            (~is_right) & live_sorted, new_group, is_run_end, big)
+        _, left_in_run = segments.run_extents(
+            (~is_right) & live_sorted, new_group, is_run_end)
         fields.append((left_in_run == 0).astype(jnp.int32))
 
     # one scatter maps per-sorted-position results back to original rows

@@ -46,6 +46,23 @@ def segment_spans(new_group: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return start, end
 
 
+def run_extents(member: jax.Array, new_group: jax.Array,
+                is_run_end: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per sorted position: (# True ``member`` rows before this position's
+    run, # True ``member`` rows inside the run).  ``new_group`` marks run
+    starts and ``is_run_end`` run ends over the same sorted order.  One
+    cumsum + one cummax run-start broadcast + one suffix-cummin run-end
+    broadcast — no scatters (the per-gid histogram scatter-add this
+    replaces serializes on TPU)."""
+    n = member.shape[0]
+    incl = jnp.cumsum(member.astype(jnp.int32))
+    excl = incl - member.astype(jnp.int32)
+    start = jax.lax.cummax(jnp.where(new_group, excl, jnp.int32(-1)))
+    end = jax.lax.cummin(jnp.where(is_run_end, incl, jnp.int32(n + 1)),
+                         reverse=True)
+    return start, end - start
+
+
 def _span_take(csum0: jax.Array, pos: jax.Array) -> jax.Array:
     return jnp.take(csum0, pos, mode="clip")
 

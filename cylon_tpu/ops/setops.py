@@ -3,10 +3,13 @@
 TPU-native replacement for the reference's hash-set set ops
 (cpp/src/cylon/table.cpp:522-734 — ``std::unordered_set<pair<int8,int64>>``
 of ⟨table_id, row⟩ with composite RowComparator hash/eq over **all**
-columns).  Here: one fused lexsort of both tables' rows → dense group ids →
-per-group membership counts via segment sums → leader selection + compaction.
-Union keeps one representative of every distinct row; intersect keeps groups
-present in both tables; subtract keeps groups of A absent from B.
+columns).  Here: one fused lexsort of both tables' rows, then everything
+stays in the sorted domain — per-run membership counts are prefix
+arithmetic (segments.run_extents), the leader is the run-start row, and
+the kept leaders compact to the front.  No group-id arrays, no scatters
+besides the final compaction.  Union keeps one representative of every
+distinct row; intersect keeps rows present in both tables; subtract keeps
+rows of A absent from B.
 """
 from __future__ import annotations
 
@@ -17,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column
-from . import common, compact
+from . import common, compact, segments
 
 
 @partial(jax.jit, static_argnames=("op", "out_capacity"))
@@ -31,32 +34,23 @@ def set_op(cols_a: Tuple[Column, ...], count_a,
     cap_a = cols_a[0].data.shape[0]
     cap_b = cols_b[0].data.shape[0]
     n = cap_a + cap_b
-    ncols = len(cols_a)
-    key = tuple(range(ncols))
-    gid_a, gid_b, perm, sorted_ops, _ = common.combined_group_ids(
+    key = tuple(range(len(cols_a)))
+    perm, _, new_group, is_run_end, live_sorted = common.combined_sorted_runs(
         cols_a, count_a, cols_b, count_b, key, key)
-
-    live_sorted = jnp.take(
-        common.two_table_padding(cap_a, count_a, cap_b, count_b), perm) == 0
     from_a_sorted = perm < cap_a
-    gid_sorted = jnp.where(from_a_sorted,
-                           jnp.take(gid_a, jnp.clip(perm, 0, cap_a - 1)),
-                           jnp.take(gid_b, jnp.clip(perm - cap_a, 0, cap_b - 1)))
 
-    cnt_a = jax.ops.segment_sum((live_sorted & from_a_sorted).astype(jnp.int32),
-                                gid_sorted, n)
-    cnt_b = jax.ops.segment_sum((live_sorted & ~from_a_sorted).astype(jnp.int32),
-                                gid_sorted, n)
+    _, a_in_run = segments.run_extents(live_sorted & from_a_sorted,
+                                       new_group, is_run_end)
+    _, b_in_run = segments.run_extents(live_sorted & ~from_a_sorted,
+                                       new_group, is_run_end)
 
-    leader = (~common_eq(sorted_ops)) & live_sorted
-    ga = jnp.take(cnt_a, gid_sorted) > 0
-    gb = jnp.take(cnt_b, gid_sorted) > 0
+    leader = new_group & live_sorted
     if op == "union":
         keep = leader
     elif op == "intersect":
-        keep = leader & ga & gb
+        keep = leader & (a_in_run > 0) & (b_in_run > 0)
     elif op == "subtract":
-        keep = leader & ga & ~gb
+        keep = leader & (a_in_run > 0) & (b_in_run == 0)
     else:
         raise ValueError(op)
 
@@ -74,9 +68,3 @@ def set_op(cols_a: Tuple[Column, ...], count_a,
                c.dtype)
         for c in out)
     return out, m
-
-
-def common_eq(sorted_ops):
-    from . import keys
-
-    return keys.rows_equal_adjacent(sorted_ops)
