@@ -164,6 +164,9 @@ def _worker(backend: str, skip: int = 0) -> int:
 # ---------------------------------------------------------------------------
 
 def _run_worker(backend: str, timeout_s: int, skip: int = 0):
+    """Returns (result_dict_or_None, timed_out: bool) — a timeout suggests a
+    transient tunnel hang (worth a spaced retry); a fast nonzero rc is a
+    permanent condition (no TPU platform at all)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", backend,
            str(skip)]
     env = dict(os.environ)
@@ -176,19 +179,19 @@ def _run_worker(backend: str, timeout_s: int, skip: int = 0):
                               timeout=timeout_s)
     except subprocess.TimeoutExpired:
         _log(f"{backend} worker timed out after {timeout_s}s")
-        return None
+        return None, True
     if proc.returncode != 0:
         _log(f"{backend} worker rc={proc.returncode}")
-        return None
+        return None, False
     for line in proc.stdout.decode().splitlines()[::-1]:
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                return json.loads(line), False
             except json.JSONDecodeError:
                 continue
     _log(f"{backend} worker emitted no JSON")
-    return None
+    return None, False
 
 
 def _pandas_worker(rows: int) -> int:
@@ -214,7 +217,7 @@ def _pandas_baseline(rows: int):
     for r in [rows, 1 << 23, 1 << 22]:
         if r > rows:
             continue
-        res = _run_worker("pandas", CPU_TIMEOUT_S, skip=r)
+        res, _ = _run_worker("pandas", CPU_TIMEOUT_S, skip=r)
         if res is not None:
             return res
     return None
@@ -232,13 +235,21 @@ def main() -> int:
     if force == "cpu":
         result = None
     else:
-        result = _run_worker("tpu", TPU_TIMEOUT_S)
+        result, timed_out = _run_worker("tpu", TPU_TIMEOUT_S)
         if result is None:
             _log("retrying tpu one size down")
-            result = _run_worker("tpu", TPU_RETRY_TIMEOUT_S, skip=1)
+            result, t2 = _run_worker("tpu", TPU_RETRY_TIMEOUT_S, skip=1)
+            timed_out = timed_out or t2
+        if result is None and timed_out:
+            # tunnel outages observed to last tens of minutes; one spaced
+            # retry salvages the round artifact when the outage is shorter
+            # (a fast nonzero rc means no TPU exists — skip straight to cpu)
+            _log("tpu timing out; sleeping 300s before a final attempt")
+            time.sleep(300)
+            result, _ = _run_worker("tpu", TPU_RETRY_TIMEOUT_S, skip=1)
     if result is None and force != "tpu":
         _log("tpu unavailable; falling back to host cpu")
-        result = _run_worker("cpu", CPU_TIMEOUT_S)
+        result, _ = _run_worker("cpu", CPU_TIMEOUT_S)
     if result is None:
         # emit an honest failure record rather than dying silently
         print(json.dumps({

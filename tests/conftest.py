@@ -34,6 +34,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: large-scale property tests (~1M rows/shard)")
+
+
 @pytest.fixture(scope="session")
 def local_ctx():
     from cylon_tpu.context import CylonContext

@@ -1,0 +1,77 @@
+"""Property tests at >=1M rows/shard vs pandas (VERDICT weak #9).
+
+The reference ships scaling drivers (cpp/src/experiments/run_dist_scaling.py,
+cpp/src/examples/bench/table_join_dist_test.cpp) but its correctness tests
+stay small; these pin correctness at a scale where multi-block kernel
+arithmetic (grid tiling, prefix-sum carries, capacity rounding) actually
+engages.  Distributions are adversarial-ish: skewed hot keys plus ~1%
+nulls in the aggregated columns.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def _table(ctx, df):
+    from cylon_tpu.table import Table
+
+    return Table.from_pandas(df, ctx=ctx)
+
+
+@pytest.mark.slow
+def test_join_groupby_1m_per_shard(ctx2, rng):
+    """2 shards x 1M rows: distributed join + two-phase groupby vs pandas."""
+    n = 2_000_000
+    nkeys = 200_000
+    # skewed keys: 10% of rows hit 100 hot keys
+    hot = rng.integers(0, 100, n)
+    cold = rng.integers(0, nkeys, n)
+    k = np.where(rng.random(n) < 0.1, hot, cold).astype(np.int64)
+    a = rng.random(n)
+    a[rng.random(n) < 0.01] = np.nan  # pandas NaN -> null on ingest
+    bvals = rng.random(n // 4)
+    bvals[rng.random(n // 4) < 0.01] = np.nan
+    left = pd.DataFrame({"k": k, "a": a})
+    right = pd.DataFrame({"k": rng.integers(0, nkeys, n // 4).astype(np.int64),
+                          "b": bvals})
+
+    tl, tr = _table(ctx2, left), _table(ctx2, right)
+    j = tl.distributed_join(tr, on="k", how="inner")
+    exp_join = left.merge(right, on="k")
+    assert j.row_count == len(exp_join)
+
+    g = j.groupby("l_k", {"a": ["sum", "count"], "b": ["mean"]})
+    got = g.to_pandas().sort_values("l_k").reset_index(drop=True)
+    gb = exp_join.groupby("k")
+    # sum(min_count=1): an all-null group sums to null (our convention),
+    # where plain pandas sum would say 0.0
+    exp = pd.DataFrame({"sum_a": gb["a"].sum(min_count=1),
+                        "count_a": gb["a"].count(),
+                        "mean_b": gb["b"].mean()}
+                       ).reset_index().sort_values("k").reset_index(drop=True)
+    assert len(got) == len(exp)
+    np.testing.assert_array_equal(got.iloc[:, 0].to_numpy(), exp["k"].to_numpy())
+    np.testing.assert_allclose(got.iloc[:, 1].to_numpy(), exp["sum_a"].to_numpy(),
+                               rtol=1e-9)
+    np.testing.assert_array_equal(got.iloc[:, 2].to_numpy(),
+                                  exp["count_a"].to_numpy())
+    np.testing.assert_allclose(got.iloc[:, 3].to_numpy(), exp["mean_b"].to_numpy(),
+                               rtol=1e-9)
+
+
+@pytest.mark.slow
+def test_unique_setops_1m(ctx2, rng):
+    """1M-row distributed unique + subtract vs pandas on duplicated keys."""
+    n = 1_000_000
+    k = rng.integers(0, n // 4, n).astype(np.int64)
+    df = pd.DataFrame({"k": k})
+    t = _table(ctx2, df)
+    u = t.distributed_unique(["k"])
+    assert u.row_count == df["k"].nunique()
+
+    other = pd.DataFrame({"k": rng.integers(0, n // 8, n // 2).astype(np.int64)})
+    s = t.distributed_subtract(_table(ctx2, other))
+    exp = np.setdiff1d(df["k"].unique(), other["k"].unique())
+    assert s.row_count == len(exp)
+    got = np.sort(s.to_pandas()["k"].to_numpy())
+    np.testing.assert_array_equal(got, np.sort(exp))
