@@ -8,9 +8,9 @@ all_to_all_arrow_tables).  Where the reference streams each buffer with 6-int
 headers through per-peer MPI state machines and busy-waits on progress
 loops, here the whole exchange is ONE jit program per shard:
 
-1. stable sort rows by target shard (the Split kernel's scatter,
-   arrow_kernels.hpp:60-96, becomes a sort+gather — no per-row append
-   loops),
+1. group rows by target shard with a stable counting scan over the
+   world-sized target alphabet (the Split kernel's per-row appends,
+   arrow_kernels.hpp:60-96, become one cumsum per target + a gather),
 2. per-target counts via segment-sum; an ``all_gather`` of the count row
    replaces the length-header handshake (the receiver "pre-allocation" is
    the static bucket size),
@@ -44,6 +44,31 @@ def target_counts(targets: jax.Array, world: int) -> jax.Array:
     return jax.ops.segment_sum(ones, targets, world + 1)[:world]
 
 
+def _perm_by_target(targets: jax.Array, world: int) -> jax.Array:
+    """Stable permutation grouping rows by target, padding (== world) last.
+
+    The target alphabet is tiny (world + 1 values), so a counting scan —
+    one cumsum per target value, unrolled at trace time — replaces the
+    stable sort the Split kernel would otherwise pay
+    (reference: arrow_kernels.hpp:60-96 appends per-target builders row by
+    row; here each target's rows get destinations base_t + rank-in-target).
+    Falls back to ``lax.sort`` for wide meshes where the unroll would bloat
+    the program."""
+    cap = targets.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    if world + 1 > 32:
+        _, perm = jax.lax.sort((targets, iota), num_keys=1, is_stable=True)
+        return perm
+    dest = jnp.zeros((cap,), jnp.int32)
+    base = jnp.zeros((), jnp.int32)
+    for t in range(world + 1):
+        m = targets == t
+        c = jnp.cumsum(m.astype(jnp.int32))
+        dest = jnp.where(m, base + c - 1, dest)
+        base = base + c[-1]
+    return jnp.zeros((cap,), jnp.int32).at[dest].set(iota)
+
+
 def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
                   world: int, bucket: int, out_capacity: int):
     """Shard-local body of the shuffle (run under shard_map).
@@ -54,11 +79,10 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
     Returns (columns, new_count) with per-shard capacity ``out_capacity``.
     """
     cap = cols[0].data.shape[0]
-    iota = jnp.arange(cap, dtype=jnp.int32)
 
     counts = target_counts(targets, world)
-    # stable sort by target: rows for shard t become contiguous, padding last
-    _, perm_t = jax.lax.sort((targets, iota), num_keys=1, is_stable=True)
+    # group rows by target: rows for shard t become contiguous, padding last
+    perm_t = _perm_by_target(targets, world)
     start = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                              jnp.cumsum(counts, dtype=jnp.int32)[:-1]])
 
@@ -147,10 +171,9 @@ def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
     (cpp/src/cylon/arrow/arrow_all_to_all.cpp:24-236).
     """
     cap = cols[0].data.shape[0]
-    iota = jnp.arange(cap, dtype=jnp.int32)
 
     counts = target_counts(targets, world)
-    _, perm_t = jax.lax.sort((targets, iota), num_keys=1, is_stable=True)
+    perm_t = _perm_by_target(targets, world)
     input_offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)[:-1]])
 
