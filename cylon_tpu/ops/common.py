@@ -41,12 +41,13 @@ def concat_columns(a: Column, b: Column) -> Column:
 
 
 def two_table_padding(cap_a: int, count_a, cap_b: int, count_b) -> jax.Array:
-    """Padding-flag operand for a concatenated pair of tables."""
+    """Padding-flag operand (bool — one packed bit) for a concatenated pair
+    of tables."""
     idx = jnp.arange(cap_a + cap_b, dtype=jnp.int32)
     in_a = idx < cap_a
     pad_a = idx >= count_a
     pad_b = (idx - cap_a) >= count_b
-    return jnp.where(in_a, pad_a, pad_b).astype(jnp.uint8)
+    return jnp.where(in_a, pad_a, pad_b)
 
 
 def combined_sorted_runs(cols_a: Sequence[Column], count_a,

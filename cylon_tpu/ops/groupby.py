@@ -234,7 +234,7 @@ def hash_groupby(cols: Tuple[Column, ...], count,
 
 def _nunique(vcol: Column, vvalid, gid, cap: int):
     """Distinct non-null values per group via a (gid, value) lexsort."""
-    ops = [(~vvalid).astype(jnp.uint8), gid] + keys.column_operands(vcol, with_validity=False)
+    ops = [~vvalid, gid] + keys.column_operands(vcol, with_validity=False)
     perm, sorted_ops = keys.lexsort_indices(ops, cap)
     eq = keys.rows_equal_adjacent(sorted_ops)
     # sorted_ops are packed words: recover fields through the permutation

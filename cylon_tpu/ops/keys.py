@@ -44,27 +44,25 @@ def pack_string_words(data: jax.Array) -> List[jax.Array]:
 
 def column_operands(col: Column, *, nulls_first: bool = True,
                     with_validity: bool = True) -> List[jax.Array]:
-    """Sortable operands for one column (most-significant first)."""
+    """Sortable operands for one column (most-significant first).  Boolean
+    operands stay ``bool`` so the bit-packer can store them in 1 bit."""
     ops: List[jax.Array] = []
     if with_validity:
         if nulls_first:
-            ops.append(col.validity.astype(jnp.uint8))   # invalid(0) < valid(1)
+            ops.append(col.validity)       # invalid(0) < valid(1)
         else:
-            ops.append((~col.validity).astype(jnp.uint8))  # valid(0) < invalid(1)
+            ops.append(~col.validity)      # valid(0) < invalid(1)
     if col.is_string:
         ops.extend(pack_string_words(col.data))
     else:
-        data = col.data
-        if data.dtype == jnp.bool_:
-            data = data.astype(jnp.uint8)
-        ops.append(data)
+        ops.append(col.data)
     return ops
 
 
 def padding_operand(capacity: int, row_count) -> jax.Array:
-    """First sort operand: 0 for live rows, 1 for padding, so padding always
-    lands at the back."""
-    return (jnp.arange(capacity, dtype=jnp.int32) >= row_count).astype(jnp.uint8)
+    """First sort operand: False for live rows, True for padding, so padding
+    always lands at the back."""
+    return jnp.arange(capacity, dtype=jnp.int32) >= row_count
 
 
 def build_operands(cols: Sequence[Column], row_count, capacity: int,
@@ -87,6 +85,8 @@ def build_operands(cols: Sequence[Column], row_count, capacity: int,
 
 def _invert_operand(x: jax.Array) -> jax.Array:
     """Order-reversing transform for one operand."""
+    if x.dtype == jnp.bool_:
+        return ~x
     if jnp.issubdtype(x.dtype, jnp.unsignedinteger):
         return ~x
     if jnp.issubdtype(x.dtype, jnp.signedinteger):
@@ -105,7 +105,7 @@ def _ordered_unsigned(x: jax.Array) -> Tuple[jax.Array, int]:
     sort to the extremes, matching lax.sort's totalorder comparator)."""
     dt = x.dtype
     if dt == jnp.bool_:
-        return x.astype(jnp.uint8), 8
+        return x, 1  # 0/1 — one bit in the packed word
     if jnp.issubdtype(dt, jnp.unsignedinteger):
         return x, dt.itemsize * 8
     w = dt.itemsize * 8
@@ -132,11 +132,15 @@ def pack_operands(operands: Sequence[jax.Array]) -> List[jax.Array]:
     into uint32 words (fields MSB-first within a word): lexicographic
     order AND rowwise equality over the packed words equal those over the
     original operand list, while the sort carries fewer arrays and
-    comparisons.  E.g. [pad u8, validity u8] packs to one u16-in-u32 word,
-    so a single-i32-key sort carries 2 operands instead of 3.  64-bit
+    comparisons.  E.g. [pad bool, validity bool, i16 key] packs to one
+    18-bit-in-u32 word, so the sort carries 1 operand instead of 3.  64-bit
     fields (i64/f64 data, packed string words) pass through as standalone
     u64 operands — the 32-bit word target keeps narrow-mode programs free
     of emulated 64-bit arrays for 32-bit data."""
+    return _pack_encoded([_ordered_unsigned(op) for op in operands])
+
+
+def _pack_encoded(enc: Sequence[Tuple[jax.Array, int]]) -> List[jax.Array]:
     out: List[jax.Array] = []
     cur = None
     used = 0
@@ -147,8 +151,7 @@ def pack_operands(operands: Sequence[jax.Array]) -> List[jax.Array]:
             out.append(cur)
         cur, used = None, 0
 
-    for op in operands:
-        bits, w = _ordered_unsigned(op)
+    for bits, w in enc:
         if w >= 64:
             flush()
             out.append(bits)
@@ -169,8 +172,44 @@ def lexsort_indices(operands: Sequence[jax.Array], capacity: int) -> Tuple[jax.A
     (permutation, sorted PACKED operands) — the packed words support
     adjacency/equality tests (rows_equal_adjacent, dense_group_ids) but
     not per-field access; gather original fields through the permutation
-    when field values are needed."""
-    packed = pack_operands(operands)
+    when field values are needed.
+
+    Fast path: when every key field plus a row index fits 64 bits (e.g.
+    padding + validity + a 32-bit key + up to 30 index bits — the
+    hash-partitioned join/groupby shape), the sort runs over one or two
+    u32 words with the index in the low bits: no payload operand, and
+    uniqueness makes stability free.  The words stay 32-bit — narrow
+    mode's zero-64-bit-arrays guarantee holds (64-bit ops are emulated on
+    TPU)."""
+    enc = [_ordered_unsigned(o) for o in operands]
+    total_bits = sum(w for _, w in enc)
+    idx_bits = max(1, (capacity - 1).bit_length()) if capacity > 1 else 1
+    if total_bits + idx_bits <= 64:
+        # assemble the logical (total+idx)-bit value MSB-first across
+        # (hi, lo) u32 words with static double-word shifts
+        hi = jnp.zeros((capacity,), jnp.uint32)
+        lo = jnp.zeros((capacity,), jnp.uint32)
+
+        def append(bits_u32, w: int):
+            nonlocal hi, lo
+            if w == 32:
+                hi, lo = lo, bits_u32
+            else:
+                hi = (hi << jnp.uint32(w)) | (lo >> jnp.uint32(32 - w))
+                lo = (lo << jnp.uint32(w)) | bits_u32
+
+        for bits, w in enc:
+            append(bits.astype(jnp.uint32), w)
+        append(jnp.arange(capacity, dtype=jnp.uint32), idx_bits)
+
+        if total_bits + idx_bits <= 32:  # everything landed in lo
+            s_lo = jax.lax.sort(lo, is_stable=False)  # keys are unique
+            perm = (s_lo & jnp.uint32((1 << idx_bits) - 1)).astype(jnp.int32)
+            return perm, [s_lo >> jnp.uint32(idx_bits)]
+        s_hi, s_lo = jax.lax.sort((hi, lo), num_keys=2, is_stable=False)
+        perm = (s_lo & jnp.uint32((1 << idx_bits) - 1)).astype(jnp.int32)
+        return perm, [s_hi, s_lo >> jnp.uint32(idx_bits)]
+    packed = _pack_encoded(enc)
     iota = jnp.arange(capacity, dtype=jnp.int32)
     sorted_all = jax.lax.sort(tuple(packed) + (iota,),
                               num_keys=len(packed), is_stable=True)
