@@ -91,6 +91,17 @@ class Table:
     def schema(self) -> List[Tuple[str, dtypes.DataType]]:
         return [(n, c.dtype) for n, c in zip(self.names, self.columns)]
 
+    @property
+    def shape(self) -> Tuple[int, int]:
+        """(rows, columns) — reference: python/pycylon/data/table.pyx:981."""
+        return (self.row_count, self.column_count)
+
+    @property
+    def context(self) -> CylonContext:
+        """The owning context — reference: data/table.pyx:207 (the repo
+        field is ``ctx``; this is the pycylon-named accessor)."""
+        return self.ctx
+
     def __repr__(self) -> str:
         cols = ", ".join(f"{n}:{c.dtype}" for n, c in zip(self.names, self.columns))
         return (f"Table[{self.row_count} rows x {self.column_count} cols | "

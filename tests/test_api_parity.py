@@ -51,3 +51,10 @@ def test_isna_notna_alias(local_ctx):
     na = t.isna().to_pandas()["v"]
     assert list(na) == [False, True, False]
     assert list(t.notna().to_pandas()["v"]) == [True, False, True]
+
+
+def test_shape_and_context(local_ctx):
+    """reference: data/table.pyx:981 (shape), :207 (context)."""
+    t = Table.from_list(["k", "v"], [[1, 2, 3], [9, 8, 7]], ctx=local_ctx)
+    assert t.shape == (3, 2)
+    assert t.context is local_ctx
