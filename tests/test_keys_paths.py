@@ -1,0 +1,121 @@
+"""Path-targeted tests for the lexsort encodings.
+
+``keys.lexsort_indices`` has three executable shapes — single-u32-word
+(key fields + index <= 32 bits), double-u32-word (<= 64 bits), and the
+multi-word packed fallback — and the shuffle's counting-scan split has a
+``lax.sort`` fallback past 32 targets.  Each path must agree with a
+numpy stable reference, including null ordering, descending flips, NaN
+canonicalization, and -0.0 == +0.0.
+"""
+import numpy as np
+import pytest
+
+
+def _device_perm(cols_np, count, cap, ascending=None):
+    import jax.numpy as jnp
+
+    from cylon_tpu import column as colmod
+    from cylon_tpu.ops import keys
+
+    cols = []
+    for data, valid in cols_np:
+        # validity passed explicitly so NaN cells survive ingestion as
+        # values (from_numpy's default treats NaN as null and zeroes it)
+        cols.append(colmod.from_numpy(data, validity=valid))
+    ops = keys.build_operands(cols, jnp.asarray(count, jnp.int32), cap,
+                              ascending=ascending)
+    perm, sorted_ops = keys.lexsort_indices(ops, cap)
+    return np.asarray(perm), [np.asarray(o) for o in sorted_ops]
+
+
+@pytest.mark.parametrize("dtype,cap", [
+    (np.int16, 64),      # single-word path: 1+1+16+6 <= 32
+    (np.int32, 64),      # double-word path: 1+1+32+6 <= 64
+    (np.float32, 64),    # double-word path incl. float canonicalization
+    (np.float64, 64),    # fallback: 64-bit field
+])
+def test_lexsort_paths_match_numpy(dtype, cap, rng):
+    import jax.numpy as jnp
+
+    from cylon_tpu.ops import keys
+
+    count = 50
+    if np.issubdtype(dtype, np.floating):
+        data = rng.standard_normal(cap).astype(dtype)
+        data[3] = np.nan
+        data[7] = -0.0
+        data[9] = 0.0
+    else:
+        data = rng.integers(-40, 40, cap).astype(dtype)
+    valid = rng.random(cap) > 0.2
+    perm, sorted_ops = _device_perm([(data, valid)], count, cap)
+
+    # permutation property
+    assert sorted(perm.tolist()) == list(range(cap))
+    # padding last
+    assert set(perm[count:].tolist()) == set(range(count, cap))
+    # live region ordered: nulls first, then ascending canonical values
+    lived = [(bool(valid[i]),
+              data[i]) for i in perm[:count]]
+    nulls = [x for x in lived if not x[0]]
+    vals = [x[1] for x in lived if x[0]]
+    assert lived[:len(nulls)] == nulls, "nulls must sort first"
+
+    def canon(v):
+        # canonical sort key: NaN above +inf (the total-order encoding),
+        # -0.0 folded into +0.0
+        if np.issubdtype(dtype, np.floating):
+            if np.isnan(v):
+                return np.inf  # ties with +inf are fine for the <= check
+            return 0.0 if v == 0 else float(v)
+        return int(v)
+
+    cv = [canon(v) for v in vals]
+    assert cv == sorted(cv)
+    if np.issubdtype(dtype, np.floating):
+        # NaN must land at the very end of the live values
+        assert np.isnan(vals[-1]) or not any(np.isnan(v) for v in vals)
+        # equality words: -0.0 groups with +0.0
+        eq = np.asarray(keys.rows_equal_adjacent(
+            [jnp.asarray(o) for o in sorted_ops]))
+        live_pos = {int(p): k for k, p in enumerate(perm[:count])}
+        zpos = sorted(live_pos[i] for i in (7, 9) if valid[i])
+        if len(zpos) == 2 and zpos[1] == zpos[0] + 1:
+            assert eq[zpos[1]], "-0.0 and +0.0 must share a key"
+
+
+def test_lexsort_descending_all_paths(rng):
+    from cylon_tpu.ops import keys  # noqa: F401
+
+    for dtype in (np.int16, np.int32, np.float64):
+        cap, count = 32, 32
+        data = (rng.standard_normal(cap).astype(dtype)
+                if np.issubdtype(dtype, np.floating)
+                else rng.integers(-99, 99, cap).astype(dtype))
+        valid = np.ones(cap, bool)
+        perm, _ = _device_perm([(data, valid)], count, cap,
+                               ascending=[False])
+        got = data[perm]
+        exp = np.sort(data)[::-1]
+        np.testing.assert_array_equal(got, exp)
+
+
+def test_perm_by_target_wide_mesh_fallback(rng):
+    """world > 31 takes the lax.sort fallback; both must agree."""
+    import jax.numpy as jnp
+
+    from cylon_tpu.parallel import shuffle
+
+    n = 1000
+    for world in (8, 40):  # counting scan vs sort fallback
+        targets = jnp.asarray(
+            np.append(rng.integers(0, world, n - 5), [world] * 5)  # 5 padding
+            .astype(np.int32))
+        perm = np.asarray(shuffle._perm_by_target(targets, world))
+        t = np.asarray(targets)
+        # stable grouping: targets nondecreasing, ties in original order
+        g = t[perm]
+        assert (np.diff(g) >= 0).all()
+        for tv in range(world + 1):
+            idx = perm[g == tv]
+            assert (np.diff(idx) > 0).all(), "must be stable within target"
