@@ -3,10 +3,11 @@
 TPU-native replacement for the reference's hash-set unique
 (cpp/src/cylon/table.cpp:966-1029 — bytell hash-set insert per row building
 a keep-filter, with 'first'/'last' keep semantics).  Here: lexsort the key
-columns, dense group ids, pick each group's first (or last) occurrence *in
-original row order* via a segment min/max over original indices, then
-compact — output preserves the input's row order like the reference's
-filter does.
+columns; the sort is stable (or embeds the row index in the key word), so
+rows inside a key run sit in original row order and each run's first/last
+position IS the group's first/last occurrence — one scatter along the
+permutation marks the kept rows, then a compaction restores original
+order like the reference's filter does.  No segment min/max needed.
 """
 from __future__ import annotations
 
@@ -25,25 +26,23 @@ def unique(cols: Tuple[Column, ...], count, key_idx: Tuple[int, ...],
            keep: str = "first"):
     """Returns (columns, new_count): rows with a duplicate key removed,
     keeping the first or last occurrence, original order preserved."""
+    if keep not in ("first", "last"):
+        raise ValueError(f"keep must be 'first' or 'last', got {keep!r}")
     cap = cols[0].data.shape[0]
     key_cols = [cols[i] for i in key_idx]
     operands = keys.build_operands(key_cols, count, cap)
     perm, sorted_ops = keys.lexsort_indices(operands, cap)
-    gid, _ = keys.dense_group_ids(sorted_ops)
     live_sorted = jnp.arange(cap, dtype=jnp.int32) < count
 
-    orig = perm  # original row index of each sorted position
+    new_group = ~keys.rows_equal_adjacent(sorted_ops)
     if keep == "first":
-        rep = jax.ops.segment_min(jnp.where(live_sorted, orig, cap), gid, cap)
-    elif keep == "last":
-        rep = jax.ops.segment_max(jnp.where(live_sorted, orig, -1), gid, cap)
-    else:
-        raise ValueError(f"keep must be 'first' or 'last', got {keep!r}")
+        rep_pos = new_group  # run start = smallest original index in the run
+    else:  # run end = largest original index in the run
+        rep_pos = jnp.concatenate([new_group[1:], jnp.ones((1,), bool)])
+    leader = rep_pos & live_sorted  # padding runs sort last -> excluded
 
-    valid_rep = (rep >= 0) & (rep < cap)
-    keep_mask = jnp.zeros((cap,), jnp.bool_).at[jnp.clip(rep, 0, cap - 1)].max(
-        valid_rep)
-    keep_mask = keep_mask & compact.live_mask(cap, count)
+    keep_mask = jnp.zeros((cap,), jnp.bool_).at[
+        jnp.where(leader, perm, cap)].set(True, mode="drop")
 
     perm_keep, m = compact.compact_indices(keep_mask)
     out = tuple(c.take(perm_keep, valid_mask=compact.live_mask(cap, m)) for c in cols)

@@ -1,0 +1,146 @@
+"""Break down the bench pipeline's steady-state cost on TPU.
+
+Times each stage of the join+groupby pipeline separately at ROWS per side:
+  1. combined lexsort (gid assignment)              [sort algo]
+  2. histogram + cumsum (match ranges)
+  3. right-side sort by gid
+  4. key_grouped left sort
+  5. expansion (scatter + cummax) + output gathers
+  6. pipeline groupby segment scatters
+Plus the full fused pipeline for reference.
+"""
+import os, sys, time
+
+os.environ.setdefault("CYLON_TPU_ACCUM", "narrow")
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+import numpy as np
+
+import cylon_tpu  # noqa
+from cylon_tpu import column as colmod
+from cylon_tpu.config import JoinType
+from cylon_tpu.ops import common, compact, groupby as groupby_mod, join as join_mod, keys, segments
+from cylon_tpu.ops.groupby import AggOp
+from cylon_tpu.table import _cap_round
+
+ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 25)
+SEED = 12345
+REPS = 3
+
+rng = np.random.default_rng(SEED)
+lk = rng.integers(0, ROWS, ROWS).astype(np.int32)
+lv = rng.random(ROWS).astype(np.float32)
+rk = rng.integers(0, ROWS, ROWS).astype(np.int32)
+rv = rng.random(ROWS).astype(np.float32)
+
+cols_l = (colmod.from_numpy(lk), colmod.from_numpy(lv))
+cols_r = (colmod.from_numpy(rk), colmod.from_numpy(rv))
+count = jnp.asarray(ROWS, jnp.int32)
+
+
+def _touch(out):
+    # the axon tunnel's block_until_ready is effectively async; a host
+    # fetch of one element forces real completion
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf[:1]))
+
+
+def timed(name, fn, *args):
+    out = fn(*args)
+    _touch(out)
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _touch(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:34s} {min(ts)*1e3:10.1f} ms", flush=True)
+    return out
+
+
+cap = ROWS
+
+# -- stage 1: combined lexsort --------------------------------------------
+@jax.jit
+def stage_sort(cl, cr, cnt):
+    gid_l, gid_r, perm, sorted_ops, num = common.combined_group_ids(
+        cl, cnt, cr, cnt, (0,), (0,))
+    return gid_l, gid_r
+
+gids = timed("combined_group_ids (sort+gid)", stage_sort, cols_l, cols_r, count)
+
+# -- stage 2: histogram + cumsum ------------------------------------------
+@jax.jit
+def stage_hist(gid_l, gid_r, cnt):
+    live_l = jnp.arange(cap, dtype=jnp.int32) < cnt
+    live_r = jnp.arange(cap, dtype=jnp.int32) < cnt
+    n_gid = 2 * cap
+    counts_r = jnp.zeros((n_gid,), jnp.int32).at[gid_r].add(live_r.astype(jnp.int32))
+    csum_r = jnp.cumsum(counts_r, dtype=jnp.int32)
+    rstart = jnp.concatenate([jnp.zeros((1,), jnp.int32), csum_r[:-1]])
+    lo = jnp.take(rstart, gid_l)
+    matches = jnp.where(live_l, jnp.take(counts_r, gid_l), 0)
+    return lo, matches
+
+lo_m = timed("histogram+cumsum+gathers", stage_hist, gids[0], gids[1], count)
+
+# -- stage 3: right sort by gid -------------------------------------------
+@jax.jit
+def stage_rsort(gid_r, cnt):
+    live_r = jnp.arange(cap, dtype=jnp.int32) < cnt
+    rkey = jnp.where(live_r, gid_r, jnp.iinfo(jnp.int32).max)
+    iota_r = jnp.arange(cap, dtype=jnp.int32)
+    _, perm_r = jax.lax.sort((rkey, iota_r), num_keys=1, is_stable=True)
+    return perm_r
+
+timed("right 1-key sort by gid", stage_rsort, gids[1], count)
+
+# -- stage 4: key_grouped left sort ----------------------------------------
+@jax.jit
+def stage_lsort(lo, matches, cnt):
+    live_l = jnp.arange(cap, dtype=jnp.int32) < cnt
+    order_key = jnp.where(live_l & (matches > 0), lo, jnp.iinfo(jnp.int32).max)
+    iota_l = jnp.arange(cap, dtype=jnp.int32)
+    _, perm_l = jax.lax.sort((order_key, iota_l), num_keys=1, is_stable=True)
+    return perm_l
+
+timed("key_grouped left sort", stage_lsort, lo_m[0], lo_m[1], count)
+
+# -- full join_gather ------------------------------------------------------
+m = int(join_mod.join_row_count(cols_l, count, cols_r, count, (0,), (0,),
+                                JoinType.INNER, "sort"))
+out_cap = _cap_round(m)
+print(f"join count {m}  out_cap {out_cap}", flush=True)
+
+@jax.jit
+def full_join(cl, cr, cnt):
+    return join_mod.join_gather(cl, cnt, cr, cnt, (0,), (0,),
+                                JoinType.INNER, out_cap, "sort",
+                                key_grouped=True)
+
+joined = timed("join_gather total", full_join, cols_l, cols_r, count)
+
+# -- groupby on joined -----------------------------------------------------
+@jax.jit
+def stage_gb(jcols, jm):
+    return groupby_mod.pipeline_groupby(jcols, jm, (0,),
+                                        ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
+
+timed("pipeline_groupby", stage_gb, joined[0], joined[1])
+
+# -- fused end-to-end ------------------------------------------------------
+@jax.jit
+def pipeline(cl, cnt_l, cr, cnt_r):
+    jcols, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r, (0,), (0,),
+                                     JoinType.INNER, out_cap, "sort",
+                                     key_grouped=True)
+    gcols, g = groupby_mod.pipeline_groupby(jcols, jm, (0,),
+                                            ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
+    return gcols[1].data, gcols[2].data, g, jm
+
+timed("FULL fused pipeline", pipeline, cols_l, count, cols_r, count)
+print("rows/sec/chip @", ROWS, flush=True)
