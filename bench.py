@@ -232,13 +232,17 @@ def main() -> int:
     if force not in (None, "cpu", "tpu"):
         _log(f"ignoring unknown CYLON_BENCH_BACKEND={force!r}")
         force = None
+    try:  # CYLON_BENCH_SKIP=n starts the size ladder n rungs down
+        skip0 = int(os.environ.get("CYLON_BENCH_SKIP", "0") or 0)
+    except ValueError:
+        skip0 = 0
     if force == "cpu":
         result = None
     else:
-        result, timed_out = _run_worker("tpu", TPU_TIMEOUT_S)
+        result, timed_out = _run_worker("tpu", TPU_TIMEOUT_S, skip=skip0)
         if result is None:
             _log("retrying tpu one size down")
-            result, t2 = _run_worker("tpu", TPU_RETRY_TIMEOUT_S, skip=1)
+            result, t2 = _run_worker("tpu", TPU_RETRY_TIMEOUT_S, skip=skip0 + 1)
             timed_out = timed_out or t2
         if result is None and timed_out:
             # tunnel outages observed to last tens of minutes; one spaced
@@ -246,7 +250,7 @@ def main() -> int:
             # (a fast nonzero rc means no TPU exists — skip straight to cpu)
             _log("tpu timing out; sleeping 300s before a final attempt")
             time.sleep(300)
-            result, _ = _run_worker("tpu", TPU_RETRY_TIMEOUT_S, skip=1)
+            result, _ = _run_worker("tpu", TPU_RETRY_TIMEOUT_S, skip=skip0 + 1)
     if result is None and force != "tpu":
         _log("tpu unavailable; falling back to host cpu")
         result, _ = _run_worker("cpu", CPU_TIMEOUT_S)

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Unattended TPU measurement battery — run when the axon tunnel is up.
+# Produces: /tmp/battery/{bench_sort.json,bench_hash.json,profile.txt,smoke.json}
+# Each step is independently timeout-guarded so one hang cannot eat the rest.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/battery}
+mkdir -p "$OUT"
+log() { echo "[battery $(date +%H:%M:%S)] $*"; }
+
+
+# bench.py's internal worst case (1500s first try + 600s retry + 300s sleep
+# + 600s final + 3x900s pandas step-down) is ~5700s; guards must exceed it
+log "1/4 bench (sort algorithm)"
+timeout 6000 python bench.py > "$OUT/bench_sort.json" 2> "$OUT/bench_sort.log"
+log "bench sort rc=$? $(cat "$OUT/bench_sort.json" 2>/dev/null | head -c 200)"
+
+log "2/4 bench (hash algorithm, one size down)"
+CYLON_BENCH_ALGO=hash CYLON_BENCH_SKIP=1 timeout 6000 python bench.py \
+    > "$OUT/bench_hash.json" 2> "$OUT/bench_hash.log"
+log "bench hash rc=$? $(cat "$OUT/bench_hash.json" 2>/dev/null | head -c 200)"
+
+log "3/4 stage profile at 32M rows/side"
+timeout 2400 python tools/profile_pipeline.py 33554432 > "$OUT/profile.txt" 2> "$OUT/profile.log"
+log "profile rc=$?"
+
+log "4/4 kernel smoke"
+timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"
+log "smoke rc=$?"
+log "done; artifacts in $OUT"
