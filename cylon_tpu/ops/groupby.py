@@ -101,7 +101,7 @@ def _agg_out_dtype(op: AggOp, dt: dtypes.DataType):
 
 
 def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
-                       ddof: int, spans=None):
+                       ddof: int, spans=None, boundaries=None):
     """One masked segment reduction; returns (values, validity_counts).
 
     Reductions are ``jax.ops.segment_*`` scatters with 32-bit operands
@@ -122,8 +122,18 @@ def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
     sums — including COUNTSUM, whose partial counts can represent far more
     rows than the shard holds — keep the per-segment scatter-add: a global
     prefix sum would overflow i32 for int data and lose precision for
-    f32."""
+    f32.
+
+    ``boundaries`` (the run-start mask over the gid-sorted rows) opts the
+    float/min/max reductions into the scatter-free segmented scan
+    (segments.segmented_reduce_sorted) when CYLON_TPU_SEGSUM=prefix —
+    rounding stays per-segment because the scan's combine resets at run
+    starts.  Integer sums stay on the scatter in every mode: their i64
+    accumulator would make the scan a 64-bit prefix program (the class
+    that has crashed this XLA TPU backend)."""
     sorted_counts = spans is not None and precision.narrow()
+    use_scan = (sorted_counts and boundaries is not None
+                and segments.prefix_reductions_enabled())
     if sorted_counts:
         start, end = spans
         cnt32 = segments.segment_sum_sorted(valid.astype(jnp.int32), start,
@@ -131,6 +141,12 @@ def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
     else:
         cnt32 = jax.ops.segment_sum(valid.astype(jnp.int32), gid, num_segments)
     cnt = cnt32 if precision.narrow() else cnt32.astype(jnp.int64)
+
+    def fsum(x):
+        if use_scan:
+            return segments.segmented_reduce_sorted(x, boundaries, end, "sum")
+        return jax.ops.segment_sum(x, gid, num_segments)
+
     if op == AggOp.COUNT:
         return cnt, cnt
     if op == AggOp.COUNTSUM:
@@ -139,13 +155,13 @@ def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
         return (s if precision.narrow() else s.astype(jnp.int64)), cnt
     if op == AggOp.SUMSQ:
         x = jnp.where(valid, data, 0).astype(precision.float_acc())
-        return jax.ops.segment_sum(x * x, gid, num_segments), cnt
+        return fsum(x * x), cnt
     if op == AggOp.SUM:
         acc = jnp.where(valid, data, jnp.zeros((), data.dtype))
         if jnp.issubdtype(data.dtype, jnp.floating):
             acc = acc.astype(precision.float_acc_for(data.dtype))
-        else:
-            acc = acc.astype(precision.int_acc())
+            return fsum(acc), cnt
+        acc = acc.astype(precision.int_acc())
         return jax.ops.segment_sum(acc, gid, num_segments), cnt
     if op == AggOp.MIN or op == AggOp.MAX:
         if jnp.issubdtype(data.dtype, jnp.floating):
@@ -157,16 +173,20 @@ def _segment_aggregate(op: AggOp, data, valid, gid, num_segments: int,
             info = jnp.iinfo(data.dtype)
             sentinel = info.max if op == AggOp.MIN else info.min
         masked = jnp.where(valid, data, jnp.asarray(sentinel, data.dtype))
-        f = jax.ops.segment_min if op == AggOp.MIN else jax.ops.segment_max
-        out = f(masked, gid, num_segments)
+        if use_scan and masked.dtype.itemsize <= 4:
+            out = segments.segmented_reduce_sorted(
+                masked, boundaries, end, "min" if op == AggOp.MIN else "max")
+        else:
+            f = jax.ops.segment_min if op == AggOp.MIN else jax.ops.segment_max
+            out = f(masked, gid, num_segments)
         return jnp.where(cnt > 0, out, jnp.zeros((), out.dtype)), cnt
     if op in (AggOp.MEAN, AggOp.VAR, AggOp.STDDEV):
         facc = precision.float_acc()
         x = jnp.where(valid, data, 0).astype(facc)
-        s = jax.ops.segment_sum(x, gid, num_segments)
+        s = fsum(x)
         if op == AggOp.MEAN:
             return s / jnp.maximum(cnt, 1).astype(facc), cnt
-        s2 = jax.ops.segment_sum(x * x, gid, num_segments)
+        s2 = fsum(x * x)
         n = jnp.maximum(cnt, 1).astype(facc)
         var = (s2 - s * s / n) / jnp.maximum(n - ddof, 1.0)
         var = jnp.maximum(var, 0.0)
@@ -221,7 +241,8 @@ def hash_groupby(cols: Tuple[Column, ...], count,
             if vcol.is_string:
                 raise TypeError(f"aggregation {op.name} unsupported on strings")
             vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid,
-                                            cap, ddof, spans=(start, end))
+                                            cap, ddof, spans=(start, end),
+                                            boundaries=new_group)
         if op in (AggOp.COUNT, AggOp.COUNTSUM, AggOp.NUNIQUE):
             validity = group_live  # a count of zero values is a valid 0
         else:
@@ -280,7 +301,8 @@ def pipeline_groupby(cols: Tuple[Column, ...], count,
             if vcol.is_string:
                 raise TypeError(f"aggregation {op.name} unsupported on strings")
             vals, cnts = _segment_aggregate(op, vcol.data, vvalid, gid,
-                                            cap, ddof, spans=(start, end))
+                                            cap, ddof, spans=(start, end),
+                                            boundaries=new_group)
         if op in (AggOp.COUNT, AggOp.COUNTSUM, AggOp.NUNIQUE):
             validity = group_live  # a count of zero values is a valid 0
         else:

@@ -92,3 +92,58 @@ def segment_count_sorted(valid: jax.Array, start: jax.Array,
     COUNT output type)."""
     return segment_sum_sorted(valid.astype(jnp.int32), start, end,
                               jnp.int32).astype(jnp.int64)
+
+
+_SEGSUM_MODE: "str | None" = None  # None = read CYLON_TPU_SEGSUM
+
+
+def set_segsum(mode: "str | None") -> None:
+    """Force ``"prefix"`` or ``"scatter"`` segment reductions (None = env).
+    Clears jit caches like precision.set_accumulation — the knob is read
+    at trace time, so cached kernels would otherwise keep the old path."""
+    global _SEGSUM_MODE
+    if mode not in (None, "prefix", "scatter"):
+        raise ValueError(f"segsum mode must be prefix/scatter, got {mode}")
+    if mode != _SEGSUM_MODE:
+        jax.clear_caches()
+    _SEGSUM_MODE = mode
+
+
+def prefix_reductions_enabled() -> bool:
+    """CYLON_TPU_SEGSUM=prefix (or set_segsum) flips narrow-mode
+    float/min/max segment reductions from scatter-adds to the segmented
+    scan below (A/B knob — scatter serializes on TPU, the scan is
+    log-depth; default stays scatter until measured on hardware).  Read at
+    trace time: set it before the first jitted compute or use set_segsum,
+    which clears the jit caches."""
+    if _SEGSUM_MODE is not None:
+        return _SEGSUM_MODE == "prefix"
+    import os
+
+    return os.environ.get("CYLON_TPU_SEGSUM") == "prefix"
+
+
+def segmented_reduce_sorted(x: jax.Array, new_group: jax.Array,
+                            end: jax.Array, op: str) -> jax.Array:
+    """Per-segment reduction over rows already grouped into runs, with NO
+    scatter: a segmented ``lax.associative_scan`` over (value, reset-flag)
+    pairs carries each run's running reduction — the combine restarts at
+    run boundaries, so rounding stays per-segment exactly like the
+    scatter-add it replaces — and the per-run total is gathered at the
+    run's last row.  ``x`` must already be masked (null/padding rows set
+    to the op's neutral element).  ``op``: 'sum' | 'min' | 'max'.
+
+    Returns values indexed by segment id (same contract as
+    ``jax.ops.segment_*`` with ``num_segments = len(x)``); ids past the
+    number of segments read the clipped last row (callers mask by group
+    liveness, as they already do for the scatter path)."""
+    fns = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+    fn = fns[op]
+
+    def combine(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb, vb, fn(va, vb)), fa | fb
+
+    run_val, _ = jax.lax.associative_scan(combine, (x, new_group))
+    return jnp.take(run_val, end - 1, mode="clip")

@@ -158,3 +158,40 @@ def test_narrow_join_groupby_pipeline(ctx4, rng, narrow_mode):
     got = got.sort_values(got.columns[0]).reset_index(drop=True)
     assert len(got) == len(exp)
     np.testing.assert_allclose(got[got.columns[1]], exp["s"], rtol=1e-3)
+
+
+@pytest.fixture()
+def prefix_segsum(narrow_mode):
+    from cylon_tpu.ops import segments
+
+    segments.set_segsum("prefix")
+    yield
+    segments.set_segsum(None)
+
+
+def test_prefix_segmented_reductions_match_scatter(ctx4, rng, prefix_segsum):
+    """CYLON_TPU_SEGSUM=prefix: the segmented-scan reductions must agree
+    with pandas (and hence with the default scatter path) on every float
+    op, min/max, and the two-phase distributed pipeline."""
+    n = 6000
+    df = pd.DataFrame({
+        "k": rng.integers(0, 40, n),
+        "v": rng.random(n).astype(np.float32),
+    })
+    df.loc[rng.integers(0, n, 60), "v"] = np.nan
+    t = _table(ctx4, df)
+    g = t.groupby(["k"], {"v": ["sum", "mean", "min", "max",
+                              "std", "var"]})
+    got = g.to_pandas().sort_values("k").reset_index(drop=True)
+    gb = df.groupby("k")["v"]
+    exp = pd.DataFrame({
+        "sum": gb.sum(min_count=1), "mean": gb.mean(),
+        "min": gb.min(), "max": gb.max(),
+        "std": gb.std(ddof=0), "var": gb.var(ddof=0),
+    }).reset_index()
+    assert len(got) == len(exp)
+    np.testing.assert_array_equal(got.iloc[:, 0].to_numpy(), exp["k"].to_numpy())
+    for i, c in enumerate(["sum", "mean", "min", "max", "std", "var"], start=1):
+        np.testing.assert_allclose(got.iloc[:, i].to_numpy(),
+                                   exp[c].to_numpy().astype(np.float32),
+                                   rtol=2e-4, atol=1e-5, err_msg=c)

@@ -20,6 +20,11 @@ CYLON_BENCH_ALGO=hash CYLON_BENCH_SKIP=1 timeout 6000 python bench.py \
     > "$OUT/bench_hash.json" 2> "$OUT/bench_hash.log"
 log "bench hash rc=$? $(cat "$OUT/bench_hash.json" 2>/dev/null | head -c 200)"
 
+log "2b/4 bench (segmented-scan reductions, one size down)"
+CYLON_TPU_SEGSUM=prefix CYLON_BENCH_SKIP=1 timeout 6000 python bench.py \
+    > "$OUT/bench_prefix.json" 2> "$OUT/bench_prefix.log"
+log "bench prefix rc=$? $(cat "$OUT/bench_prefix.json" 2>/dev/null | head -c 200)"
+
 log "3/4 stage profile at 32M rows/side"
 timeout 2400 python tools/profile_pipeline.py 33554432 > "$OUT/profile.txt" 2> "$OUT/profile.log"
 log "profile rc=$?"
