@@ -153,7 +153,16 @@ def _worker(backend: str, skip: int = 0) -> int:
         except Exception as e:  # OOM / compile failure: step down
             _log(f"rows={rows} failed: {type(e).__name__}: {str(e)[:300]}")
             continue
-        print(json.dumps({"value": value, "rows": rows, "backend": plat}),
+        from cylon_tpu import precision as _prec
+        from cylon_tpu.ops import segments as _segs
+
+        # report the EFFECTIVE reduction path, not the env request: the
+        # prefix scan only engages under narrow mode with the exact knob
+        segsum = ("prefix" if _segs.prefix_reductions_enabled()
+                  and _prec.narrow() else "scatter")
+        print(json.dumps({"value": value, "rows": rows, "backend": plat,
+                          "algo": os.environ.get("CYLON_BENCH_ALGO", "sort"),
+                          "segsum": segsum}),
               flush=True)
         return 0
     return 4
@@ -273,6 +282,8 @@ def main() -> int:
                         if base else None),
         "rows_per_side": result["rows"],
         "backend": result["backend"],
+        "algo": result.get("algo", "sort"),
+        "segsum": result.get("segsum", "scatter"),
     }
     if base:
         out["baseline_rows"] = base["rows"]
