@@ -1,29 +1,31 @@
 """Break down the bench pipeline's steady-state cost on TPU.
 
-Times each stage of the join+groupby pipeline separately at ROWS per side:
-  1. combined lexsort (gid assignment)              [sort algo]
-  2. histogram + cumsum (match ranges)
-  3. right-side sort by gid
-  4. key_grouped left sort
-  5. expansion (scatter + cummax) + output gathers
-  6. pipeline groupby segment scatters
-Plus the full fused pipeline for reference.
+Times each stage of the join+groupby pipeline separately at ROWS per side
+(plus the fused end-to-end program), forcing a tiny host fetch per rep —
+the axon tunnel's block_until_ready alone does not reliably synchronize.
+
+Usage: python tools/profile_pipeline.py [rows_per_side]
 """
-import os, sys, time
+import os
+import sys
+import time
 
 os.environ.setdefault("CYLON_TPU_ACCUM", "narrow")
 import jax
 import jax.numpy as jnp
-
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-
 import numpy as np
 
-import cylon_tpu  # noqa
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO_ROOT, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+sys.path.insert(0, REPO_ROOT)
+import cylon_tpu  # noqa: F401,E402
 from cylon_tpu import column as colmod
 from cylon_tpu.config import JoinType
-from cylon_tpu.ops import common, compact, groupby as groupby_mod, join as join_mod, keys, segments
+from cylon_tpu.ops import common, compact, groupby as groupby_mod
+from cylon_tpu.ops import join as join_mod, segments
 from cylon_tpu.ops.groupby import AggOp
 from cylon_tpu.table import _cap_round
 
@@ -40,11 +42,10 @@ rv = rng.random(ROWS).astype(np.float32)
 cols_l = (colmod.from_numpy(lk), colmod.from_numpy(lv))
 cols_r = (colmod.from_numpy(rk), colmod.from_numpy(rv))
 count = jnp.asarray(ROWS, jnp.int32)
+cap = ROWS
 
 
 def _touch(out):
-    # the axon tunnel's block_until_ready is effectively async; a host
-    # fetch of one element forces real completion
     leaf = jax.tree_util.tree_leaves(out)[0]
     np.asarray(jax.device_get(leaf[:1]))
 
@@ -62,53 +63,40 @@ def timed(name, fn, *args):
     return out
 
 
-cap = ROWS
-
-# -- stage 1: combined lexsort --------------------------------------------
+# -- stage 1: the combined lexsort + run boundaries ------------------------
 @jax.jit
 def stage_sort(cl, cr, cnt):
-    gid_l, gid_r, perm, sorted_ops, num = common.combined_group_ids(
+    perm, _, new_group, is_run_end, live_sorted = common.combined_sorted_runs(
         cl, cnt, cr, cnt, (0,), (0,))
-    return gid_l, gid_r
+    return perm, new_group, is_run_end, live_sorted
 
-gids = timed("combined_group_ids (sort+gid)", stage_sort, cols_l, cols_r, count)
+sorted_parts = timed("combined sort + run boundaries", stage_sort,
+                     cols_l, cols_r, count)
 
-# -- stage 2: histogram + cumsum ------------------------------------------
+# -- stage 2: run extents (prefix arithmetic) ------------------------------
 @jax.jit
-def stage_hist(gid_l, gid_r, cnt):
-    live_l = jnp.arange(cap, dtype=jnp.int32) < cnt
-    live_r = jnp.arange(cap, dtype=jnp.int32) < cnt
-    n_gid = 2 * cap
-    counts_r = jnp.zeros((n_gid,), jnp.int32).at[gid_r].add(live_r.astype(jnp.int32))
-    csum_r = jnp.cumsum(counts_r, dtype=jnp.int32)
-    rstart = jnp.concatenate([jnp.zeros((1,), jnp.int32), csum_r[:-1]])
-    lo = jnp.take(rstart, gid_l)
-    matches = jnp.where(live_l, jnp.take(counts_r, gid_l), 0)
-    return lo, matches
+def stage_extents(perm, new_group, is_run_end, live_sorted):
+    is_right = perm >= cap
+    return segments.run_extents(is_right & live_sorted, new_group, is_run_end)
 
-lo_m = timed("histogram+cumsum+gathers", stage_hist, gids[0], gids[1], count)
+extents = timed("run extents (cumsum+cummax+cummin)", stage_extents,
+                *sorted_parts)
 
-# -- stage 3: right sort by gid -------------------------------------------
+# -- stage 3: back-scatter + compactions -----------------------------------
 @jax.jit
-def stage_rsort(gid_r, cnt):
-    live_r = jnp.arange(cap, dtype=jnp.int32) < cnt
-    rkey = jnp.where(live_r, gid_r, jnp.iinfo(jnp.int32).max)
-    iota_r = jnp.arange(cap, dtype=jnp.int32)
-    _, perm_r = jax.lax.sort((rkey, iota_r), num_keys=1, is_stable=True)
-    return perm_r
+def stage_back(perm, lo_sorted, matches_sorted):
+    n = 2 * cap
+    back = jnp.zeros((n, 2), jnp.int32).at[perm].set(
+        jnp.stack([lo_sorted, matches_sorted], axis=1))
+    is_right = perm >= cap
+    idx_r, _ = compact.compact_indices(is_right)
+    perm_r = jnp.take(perm, idx_r[:cap]) - cap
+    idx_l, _ = compact.compact_indices(~is_right)
+    left_key_order = jnp.take(perm, idx_l[:cap])
+    return back, perm_r, left_key_order
 
-timed("right 1-key sort by gid", stage_rsort, gids[1], count)
-
-# -- stage 4: key_grouped left sort ----------------------------------------
-@jax.jit
-def stage_lsort(lo, matches, cnt):
-    live_l = jnp.arange(cap, dtype=jnp.int32) < cnt
-    order_key = jnp.where(live_l & (matches > 0), lo, jnp.iinfo(jnp.int32).max)
-    iota_l = jnp.arange(cap, dtype=jnp.int32)
-    _, perm_l = jax.lax.sort((order_key, iota_l), num_keys=1, is_stable=True)
-    return perm_l
-
-timed("key_grouped left sort", stage_lsort, lo_m[0], lo_m[1], count)
+timed("back-scatter + 2 compactions", stage_back, sorted_parts[0],
+      extents[0], extents[1])
 
 # -- full join_gather ------------------------------------------------------
 m = int(join_mod.join_row_count(cols_l, count, cols_r, count, (0,), (0,),
@@ -143,4 +131,4 @@ def pipeline(cl, cnt_l, cr, cnt_r):
     return gcols[1].data, gcols[2].data, g, jm
 
 timed("FULL fused pipeline", pipeline, cols_l, count, cols_r, count)
-print("rows/sec/chip @", ROWS, flush=True)
+print(f"done @ {ROWS} rows/side", flush=True)
