@@ -75,3 +75,24 @@ def test_unique_setops_1m(ctx2, rng):
     assert s.row_count == len(exp)
     got = np.sort(s.to_pandas()["k"].to_numpy())
     np.testing.assert_array_equal(got, np.sort(exp))
+
+
+@pytest.mark.slow
+def test_string_key_join_200k(ctx4, rng):
+    """200K-row distributed join on string keys vs pandas (exercises the
+    packed-word string operands and width reconciliation at scale)."""
+    n = 200_000
+    keys = np.array([f"user_{i:06d}" for i in rng.integers(0, 30_000, n)])
+    left = pd.DataFrame({"k": keys, "a": rng.random(n)})
+    rk = np.array([f"user_{i:06d}" for i in rng.integers(0, 30_000, n // 5)])
+    right = pd.DataFrame({"k": rk, "b": rng.random(n // 5)})
+    tl, tr = _table(ctx4, left), _table(ctx4, right)
+    j = tl.distributed_join(tr, on="k", how="inner")
+    exp = left.merge(right, on="k")
+    assert j.row_count == len(exp)
+    gs = j.groupby("l_k", {"a": ["count"]}).to_pandas()
+    es = exp.groupby("k").agg(c=("a", "count")).reset_index()
+    gs = gs.sort_values(gs.columns[0]).reset_index(drop=True)
+    assert len(gs) == len(es)
+    assert (gs.iloc[:, 0].to_numpy() == es["k"].to_numpy()).all()
+    assert (gs.iloc[:, 1].to_numpy() == es["c"].to_numpy()).all()
