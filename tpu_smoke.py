@@ -75,6 +75,30 @@ def main() -> int:
     record("pallas_hash_partition", lambda: jax.block_until_ready(
         pallas_kernels.hash_partition([k], 8)[1]))
 
+    def prefix_segsum():
+        # segmented-scan reductions must compile and agree with the scatter
+        # path on the chip; both arms are pinned explicitly so operator env
+        # (CYLON_TPU_SEGSUM / CYLON_TPU_ACCUM) cannot collapse the A/B into
+        # comparing one path against itself
+        from cylon_tpu import precision
+        from cylon_tpu.ops import segments
+
+        aggs = ((1, gmod.AggOp.SUM), (1, gmod.AggOp.MEAN))
+        precision.set_accumulation("narrow")
+        segments.set_segsum("scatter")
+        try:
+            b0 = np.asarray(
+                gmod.hash_groupby((k, v), cnt, (0,), aggs, 0)[0][1].data)
+            segments.set_segsum("prefix")
+            a0 = np.asarray(
+                gmod.hash_groupby((k, v), cnt, (0,), aggs, 0)[0][1].data)
+        finally:
+            segments.set_segsum(None)
+            precision.set_accumulation(None)
+        np.testing.assert_allclose(a0, b0, rtol=1e-5, atol=1e-6)
+
+    record("prefix_segsum_groupby", prefix_segsum)
+
     # distributed ops on a 1-device mesh: exercises shard_map + collectives
     # + the RaggedAllToAll exchange on the real chip
     ctx = CylonContext.InitDistributed(TPUConfig(world_size=1))
