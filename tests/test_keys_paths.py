@@ -119,3 +119,29 @@ def test_perm_by_target_wide_mesh_fallback(rng):
         for tv in range(world + 1):
             idx = perm[g == tv]
             assert (np.diff(idx) > 0).all(), "must be stable within target"
+
+
+def test_lexsort_64bit_boundary(rng):
+    """3 x i16 keys: pad(1) + 3*(validity+16) = 52 bits; cap 4096 gives
+    idx_bits 12 -> exactly 64 (fast path ceiling), cap 8192 gives 65 ->
+    multi-word fallback.  Both must produce the same multiset grouping as
+    a numpy lexsort."""
+    import jax.numpy as jnp
+
+    from cylon_tpu.ops import keys
+
+    for cap in (4096, 8192):
+        count = cap - 37
+        cols_np = [rng.integers(-5, 5, cap).astype(np.int16) for _ in range(3)]
+        perm, sorted_ops = _device_perm(
+            [(c, np.ones(cap, bool)) for c in cols_np], count, cap)
+        assert sorted(perm.tolist()) == list(range(cap))
+        assert set(perm[count:].tolist()) == set(range(count, cap))
+        got = [tuple(int(c[i]) for c in cols_np) for i in perm[:count]]
+        exp = sorted(tuple(int(c[i]) for c in cols_np) for i in range(count))
+        assert got == exp, f"cap={cap}"
+        # equality words break exactly at key changes
+        eq = np.asarray(keys.rows_equal_adjacent(
+            [jnp.asarray(o) for o in sorted_ops]))[:count]
+        exp_eq = [False] + [got[i] == got[i - 1] for i in range(1, count)]
+        assert eq.tolist() == exp_eq, f"cap={cap}"
