@@ -195,13 +195,39 @@ class CylonContext:
         return f"CylonContext({kind}, world_size={self.GetWorldSize()})"
 
 
-def ctx_cache(ctx: CylonContext, name: str) -> Dict:
+class LRUCache(dict):
+    """dict with a size bound: setting past ``maxsize`` evicts the least
+    recently used entry (``get`` hits refresh recency).  Bounds program
+    caches keyed by caller-supplied objects (e.g. select predicates) so a
+    long-lived context issuing ad-hoc lambdas cannot grow without limit."""
+
+    def __init__(self, maxsize: int):
+        super().__init__()
+        self.maxsize = maxsize
+
+    def get(self, key, default=None):
+        if key in self:
+            val = super().pop(key)
+            super().__setitem__(key, val)
+            return val
+        return default
+
+    def __setitem__(self, key, value):
+        if key in self:
+            super().pop(key)
+        super().__setitem__(key, value)
+        while len(self) > self.maxsize:
+            super().pop(next(iter(self)))
+
+
+def ctx_cache(ctx: CylonContext, name: str, maxsize: int | None = None) -> Dict:
     """Per-context cache dict stored on the context object itself — dies
     with the context (no id()-reuse aliasing, no global leak).  Used for
-    jitted shard programs and plan capacities keyed by this context."""
+    jitted shard programs and plan capacities keyed by this context.
+    ``maxsize`` (honored at creation) makes it an LRU."""
     cache = getattr(ctx, name, None)
     if cache is None:
-        cache = {}
+        cache = {} if maxsize is None else LRUCache(maxsize)
         setattr(ctx, name, cache)
     return cache
 

@@ -53,7 +53,12 @@ def run_extents(member: jax.Array, new_group: jax.Array,
     starts and ``is_run_end`` run ends over the same sorted order.  One
     cumsum + one cummax run-start broadcast + one suffix-cummin run-end
     broadcast — no scatters (the per-gid histogram scatter-add this
-    replaces serializes on TPU)."""
+    replaces serializes on TPU).
+
+    Precondition (as for segment_spans): ``new_group[0]`` must be True for
+    nonempty input — otherwise ``start`` stays -1 across the first run.
+    All callers satisfy it because rows_equal_adjacent forces row 0 to
+    start a run."""
     n = member.shape[0]
     incl = jnp.cumsum(member.astype(jnp.int32))
     excl = incl - member.astype(jnp.int32)

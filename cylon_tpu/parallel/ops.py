@@ -108,9 +108,6 @@ def _targets(tt, key_idx, world, mode, opts: SortOptions | None):
         ascending=opts.ascending, nulls_first=opts.nulls_first)
 
 
-_RAGGED_A2A: bool | None = None  # None = unprobed; False = backend lacks it
-
-
 def _probe_ragged(ctx) -> bool:
     """One tiny RaggedAllToAll program on the context's mesh: each rank
     sends one element to every rank.  Compile+run success means the
@@ -145,19 +142,24 @@ def _probe_ragged(ctx) -> bool:
 
 
 def _ragged_enabled(ctx) -> bool:
+    """Capability check, cached PER CONTEXT: a process that touches a
+    CPU-mesh context first (probe -> False) and later a TPU context must
+    re-probe on the TPU mesh, not inherit the CPU verdict."""
     import os
 
-    global _RAGGED_A2A
+    from ..context import ctx_cache
+
     env = os.environ.get("CYLON_TPU_SHUFFLE")
     if env == "bucketed":
         return False
-    if _RAGGED_A2A is None:
-        _RAGGED_A2A = _probe_ragged(ctx)
-    if env == "ragged" and not _RAGGED_A2A:
+    cache = ctx_cache(ctx, "_ragged_probe")
+    if "ragged" not in cache:
+        cache["ragged"] = _probe_ragged(ctx)
+    if env == "ragged" and not cache["ragged"]:
         raise RuntimeError(
             "CYLON_TPU_SHUFFLE=ragged requested but this backend does not "
             "implement RaggedAllToAll")
-    return _RAGGED_A2A
+    return cache["ragged"]
 
 
 def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",

@@ -53,8 +53,14 @@ def _perm_by_target(targets: jax.Array, world: int) -> jax.Array:
     (reference: arrow_kernels.hpp:60-96 appends per-target builders row by
     row; here each target's rows get destinations base_t + rank-in-target).
     Falls back to ``lax.sort`` for wide meshes where the unroll would bloat
-    the program."""
+    the program.
+
+    Precondition: targets in [0, world] (world == padding).  Producers
+    (hash_targets/range_targets) guarantee it; the clip below makes an
+    out-of-range producer bug corrupt counts (visible downstream) instead of
+    silently colliding destinations into slot 0."""
     cap = targets.shape[0]
+    targets = jnp.clip(targets, 0, world)
     iota = jnp.arange(cap, dtype=jnp.int32)
     if world + 1 > 32:
         _, perm = jax.lax.sort((targets, iota), num_keys=1, is_stable=True)

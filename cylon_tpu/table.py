@@ -185,17 +185,21 @@ class Table:
 
         return io_mod.read_parquet(paths, options, ctx, capacity)
 
-    def to_csv(self, path, options=None) -> None:
-        """reference: Table::WriteCSV (table.cpp:243-256)."""
+    def to_csv(self, path, options=None, per_shard: bool = False) -> None:
+        """reference: Table::WriteCSV (table.cpp:243-256).  With
+        ``per_shard=True``, ``path`` must contain a ``{shard}`` placeholder
+        and each process-local shard is written to its own file — no
+        gather, the scalable inverse of the list-of-paths read."""
         from . import io as io_mod
 
-        io_mod.write_csv(self, path, options)
+        io_mod.write_csv(self, path, options, per_shard=per_shard)
 
-    def to_parquet(self, path, options=None) -> None:
-        """reference: Table::WriteParquet (table.cpp:1118-1131)."""
+    def to_parquet(self, path, options=None, per_shard: bool = False) -> None:
+        """reference: Table::WriteParquet (table.cpp:1118-1131); per-shard
+        mode as in ``to_csv``."""
         from . import io as io_mod
 
-        io_mod.write_parquet(self, path, options)
+        io_mod.write_parquet(self, path, options, per_shard=per_shard)
 
     @staticmethod
     def from_numpy(names: Sequence[str], arrays: Sequence[np.ndarray],
@@ -245,6 +249,41 @@ class Table:
             out_cols.append(Column(jnp.asarray(d), jnp.asarray(v),
                                    None if l is None else jnp.asarray(l), col.dtype))
         return out_cols, total
+
+    def _addressable_host_shards(self) -> List[Tuple[int, List[Column], int]]:
+        """Host views of every shard whose device buffers live on this
+        process: [(shard_id, columns, live_count)], shard-cap buffers.
+
+        The gather-free twin of ``_gathered_columns`` — on multi-host each
+        process sees only its own shards, mirroring the reference's
+        rank-local table writes (table.cpp:243-256 WriteCSV writes the
+        calling rank's partition, never a gathered table)."""
+        # columns here hold HOST (numpy) buffers: the writers only slice and
+        # np.asarray them, so wrapping back into device arrays would buy a
+        # pointless H2D+D2H round-trip per shard
+        counts = _host_row_counts(self)
+        if self.num_shards == 1:
+            cols_h = jax.device_get(self.columns)
+            cols = [Column(np.asarray(c.data), np.asarray(c.validity),
+                           None if co.lengths is None
+                           else np.asarray(c.lengths), co.dtype)
+                    for co, c in zip(self.columns, cols_h)]
+            return [(0, cols, int(counts[0]))]
+        cap = self.shard_capacity
+        piece_maps = []
+        for col in self.columns:
+            dm = _host_shard_pieces(col.data, cap)
+            vm = _host_shard_pieces(col.validity, cap)
+            lm = (None if col.lengths is None
+                  else _host_shard_pieces(col.lengths, cap))
+            piece_maps.append((dm, vm, lm))
+        out: List[Tuple[int, List[Column], int]] = []
+        for sid in sorted(piece_maps[0][0]):
+            cols = [Column(dm[sid], vm[sid],
+                           None if lm is None else lm[sid], col.dtype)
+                    for col, (dm, vm, lm) in zip(self.columns, piece_maps)]
+            out.append((sid, cols, int(counts[sid])))
+        return out
 
     def to_arrow(self):
         import pyarrow as pa
@@ -851,7 +890,9 @@ def _shard_wise(ctx: CylonContext, fn, *tables: Table, key: tuple):
         return fn(*tables)
     from jax.sharding import PartitionSpec as P
 
-    cache = ctx_cache(ctx, "_shard_fn_cache")
+    # LRU-bounded: select predicates key entries by object identity, so an
+    # unbounded dict would leak one compiled program per ad-hoc lambda
+    cache = ctx_cache(ctx, "_shard_fn_cache", maxsize=256)
     cache_key = (key, t0.num_shards,
                  tuple(t.capacity for t in tables),
                  tuple(t.names for t in tables),
@@ -864,6 +905,22 @@ def _shard_wise(ctx: CylonContext, fn, *tables: Table, key: tuple):
                                       out_specs=spec, check_vma=False))
         cache[cache_key] = entry
     return entry(*tables)
+
+
+def _host_shard_pieces(arr: jax.Array, cap: int) -> Dict[int, np.ndarray]:
+    """shard_id -> host ndarray of that shard's rows, from the array's
+    process-addressable device buffers only (no cross-process transfer).
+    A replicated buffer spans every shard and is sliced accordingly."""
+    out: Dict[int, np.ndarray] = {}
+    for sh in arr.addressable_shards:
+        idx = sh.index[0] if sh.index else slice(None)
+        start = 0 if idx.start is None else int(idx.start)
+        rows = np.asarray(sh.data)
+        for k in range(rows.shape[0] // cap):
+            sid = (start + k * cap) // cap
+            if sid not in out:
+                out[sid] = rows[k * cap:(k + 1) * cap]
+    return out
 
 
 def _host_row_counts(t: Table) -> np.ndarray:

@@ -110,7 +110,9 @@ def main() -> int:
     def ragged_shuffle():
         s = par_ops._shuffled(t, (0,), "hash")
         assert s.row_count == df_rows
-        assert par_ops._RAGGED_A2A is True, "ragged path did not activate"
+        from cylon_tpu.context import ctx_cache
+        assert ctx_cache(ctx, "_ragged_probe").get("ragged") is True, \
+            "ragged path did not activate"
 
     record("ragged_shuffle_mesh1", ragged_shuffle)
 
