@@ -159,19 +159,23 @@ def read_parquet(paths: Union[PathLike, Sequence[PathLike]],
                                     string_width=options.string_width)
 
 
-def write_csv(table, path: PathLike,
-              options: Optional[CSVWriteOptions] = None) -> None:
-    """Gathered CSV write (reference: Table::WriteCSV, table.cpp:243-256).
+def _shard_path(path: PathLike, shard: int) -> str:
+    p = str(path)
+    if "{shard}" not in p:
+        raise CylonError(Code.Invalid,
+                         "per_shard write needs a '{shard}' placeholder in "
+                         f"the path, got {p!r}")
+    # token replacement, not str.format: other braces in the path (legal on
+    # POSIX) must pass through literally, not raise KeyError mid-write
+    return p.replace("{shard}", str(shard))
 
-    Uses the native (C++) writer when available; pandas fallback."""
+
+def _write_csv_columns(cols, total: int, names, path: str,
+                       options: CSVWriteOptions) -> None:
+    """One local column set -> one CSV file (native writer when possible)."""
     import os
 
-    options = options or CSVWriteOptions()
-    names = list(table.column_names)
-    if options.column_names is not None:
-        if len(options.column_names) != len(names):
-            raise CylonError(Code.Invalid, "column_names length mismatch")
-        names = list(options.column_names)
+    from .. import column as column_mod
     from .. import dtypes, native
 
     # temporal columns need logical formatting (datetime strings, not raw
@@ -179,32 +183,75 @@ def write_csv(table, path: PathLike,
     temporal = any(c.dtype.type in (dtypes.Type.TIMESTAMP, dtypes.Type.DATE32,
                                     dtypes.Type.DATE64, dtypes.Type.TIME32,
                                     dtypes.Type.TIME64)
-                   for c in table.columns)
+                   for c in cols)
     if (native.available() and not temporal
             and not os.environ.get("CYLON_TPU_NO_NATIVE_IO")):
         import numpy as np
 
-        cols, total = table._gathered_columns()
         arrays, validities, lengths_list = [], [], []
         for c in cols:
             arrays.append(np.asarray(c.data[:total]))
             validities.append(np.asarray(c.validity[:total]))
             lengths_list.append(
                 None if c.lengths is None else np.asarray(c.lengths[:total]))
-        native.csv_write(str(path), names, arrays, validities, lengths_list,
+        native.csv_write(path, names, arrays, validities, lengths_list,
                          delimiter=options.delimiter)
         return
-    df = table.to_pandas()
-    df.columns = names
-    df.to_csv(str(path), sep=options.delimiter, index=False)
+    import pyarrow as pa
+
+    df = pa.table([column_mod.to_arrow(c, total) for c in cols],
+                  names=names).to_pandas()
+    df.to_csv(path, sep=options.delimiter, index=False)
+
+
+def _out_names(table, options) -> list:
+    names = list(table.column_names)
+    if getattr(options, "column_names", None) is not None:
+        if len(options.column_names) != len(names):
+            raise CylonError(Code.Invalid, "column_names length mismatch")
+        names = list(options.column_names)
+    return names
+
+
+def write_csv(table, path: PathLike, options: Optional[CSVWriteOptions] = None,
+              per_shard: bool = False) -> None:
+    """CSV write (reference: Table::WriteCSV, table.cpp:243-256 — each MPI
+    rank writes ITS OWN partition).
+
+    per_shard=False gathers the whole distributed table to this host (fine
+    for small exports, a dead end at scale); per_shard=True is the
+    reference-faithful scalable path: one file per process-local shard,
+    ``path`` carries a ``{shard}`` placeholder, and the file list round-trips
+    through the list-of-paths reader (file i -> shard i)."""
+    options = options or CSVWriteOptions()
+    names = _out_names(table, options)
+    if per_shard:
+        for sid, cols, count in table._addressable_host_shards():
+            _write_csv_columns(cols, count, names, _shard_path(path, sid),
+                               options)
+        return
+    cols, total = table._gathered_columns()
+    _write_csv_columns(cols, total, names, str(path), options)
 
 
 def write_parquet(table, path: PathLike,
-                  options: Optional[ParquetOptions] = None) -> None:
+                  options: Optional[ParquetOptions] = None,
+                  per_shard: bool = False) -> None:
     """reference: io::WriteParquet (io/arrow_io.cpp:94-116,
-    table.cpp:1118-1131)."""
+    table.cpp:1118-1131); ``per_shard`` as in :func:`write_csv`."""
+    import pyarrow as pa
     import pyarrow.parquet as pq
 
+    from .. import column as column_mod
+
     options = options or ParquetOptions()
+    if per_shard:
+        names = list(table.column_names)
+        for sid, cols, count in table._addressable_host_shards():
+            pq.write_table(
+                pa.table([column_mod.to_arrow(c, count) for c in cols],
+                         names=names),
+                _shard_path(path, sid), row_group_size=options.chunk_size)
+        return
     pq.write_table(table.to_arrow(), str(path),
                    row_group_size=options.chunk_size)

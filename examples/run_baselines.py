@@ -29,7 +29,9 @@ def main() -> int:
     for name, fn in [
         ("join_csv", lambda: join_csv.run(p["join_rows"])),
         ("tpch_q1", lambda: tpch_q1.run(p["q1_sf"])),
-        ("shuffle", lambda: shuffle_bench.run(p["shuffle_rows"])),
+        ("shuffle", lambda: shuffle_bench.run(
+            p["shuffle_rows"],
+            out_dir="/tmp/shuffle_out" if preset == "full" else None)),
         ("tpch_q5", lambda: tpch_q5.run(p["q5_sf"])),
         ("etl_to_flax", lambda: etl_to_flax.run(p["events"])),
     ]:

@@ -16,7 +16,7 @@ from .util import default_ctx, emit, table_from_arrays
 
 
 def run(rows: int = 1 << 20, world: int | None = None, seed: int = 0,
-        reps: int = 3) -> dict:
+        reps: int = 3, out_dir: str | None = None) -> dict:
     ctx = default_ctx(world)
     rng = np.random.default_rng(seed)
     data = {
@@ -35,8 +35,20 @@ def run(rows: int = 1 << 20, world: int | None = None, seed: int = 0,
         assert s.row_count == rows  # blocks on the exchange
         times.append(time.perf_counter() - t0)
     dt = min(times)
-    return emit("shuffle", rows=rows, seconds=dt, rows_per_sec=rows / dt,
-                world=ctx.GetWorldSize(), reps=reps)
+    res = emit("shuffle", rows=rows, seconds=dt, rows_per_sec=rows / dt,
+               world=ctx.GetWorldSize(), reps=reps)
+    if out_dir is not None:
+        # scalable egress: one parquet file per shard, no gather — the
+        # full-preset path exercises the per-shard writer at size
+        import os
+        import time as _t
+
+        os.makedirs(out_dir, exist_ok=True)
+        t0 = _t.perf_counter()
+        s.to_parquet(os.path.join(out_dir, "shard_{shard}.parquet"),
+                     per_shard=True)
+        res["write_seconds"] = _t.perf_counter() - t0
+    return res
 
 
 if __name__ == "__main__":

@@ -120,3 +120,53 @@ def test_parquet_multi_file_distributed(tmp_path, ctx2, rng):
     assert t.row_count == sum(len(f) for f in frames)
     pd.testing.assert_frame_equal(
         t.to_pandas(), pd.concat(frames, ignore_index=True))
+
+
+def test_csv_per_shard_roundtrip_world4(tmp_path, ctx4, rng):
+    """world-4 per-shard write -> per-shard read -> multiset-equal
+    (reference: rank-local WriteCSV, table.cpp:243-256)."""
+    from tests.utils import assert_rows_equal
+
+    df = _frame(rng, 101)
+    t = Table.from_pandas(df, ctx=ctx4)
+    tpl = tmp_path / "part_{shard}.csv"
+    t.to_csv(tpl, per_shard=True)
+    paths = sorted(tmp_path.glob("part_*.csv"))
+    assert len(paths) == 4
+    back = Table.from_csv(paths, ctx=ctx4)
+    assert back.num_shards == 4
+    assert_rows_equal(back, df)
+    # per-shard files hold exactly that shard's rows (no duplication)
+    sizes = [len(pd.read_csv(p)) for p in paths]
+    assert sum(sizes) == len(df)
+    assert sizes == [int(c) for c in np.asarray(t.row_counts)]
+
+
+def test_csv_per_shard_requires_placeholder(tmp_path, ctx4, rng):
+    from cylon_tpu import CylonError
+
+    t = Table.from_pandas(_frame(rng, 16), ctx=ctx4)
+    with pytest.raises(CylonError):
+        t.to_csv(tmp_path / "flat.csv", per_shard=True)
+
+
+def test_parquet_per_shard_roundtrip_world4(tmp_path, ctx4, rng):
+    from tests.utils import assert_rows_equal
+
+    df = _frame(rng, 77)
+    df.loc[5, "v"] = np.nan  # nulls survive the parquet path
+    t = Table.from_pandas(df, ctx=ctx4)
+    t.to_parquet(tmp_path / "part_{shard}.parquet", per_shard=True)
+    paths = sorted(tmp_path.glob("part_*.parquet"))
+    assert len(paths) == 4
+    back = Table.from_parquet(paths, ctx=ctx4)
+    assert_rows_equal(back, df)
+
+
+def test_per_shard_write_local_table(tmp_path, local_ctx, rng):
+    """per_shard on a 1-shard table writes exactly one file (shard 0)."""
+    df = _frame(rng, 12)
+    t = Table.from_pandas(df, ctx=local_ctx)
+    t.to_csv(tmp_path / "p_{shard}.csv", per_shard=True)
+    got = pd.read_csv(tmp_path / "p_0.csv")
+    assert len(got) == len(df)
