@@ -1,0 +1,152 @@
+"""Out-of-core execution: key-range-chunked pipelines for inputs larger
+than one chip's HBM.
+
+The reference scales past one node by adding MPI ranks
+(docs/docs/arch.md:146-162 — each rank holds a partition, the shuffle
+moves rows); on a single TPU chip the analog is to split the KEYSPACE
+into P disjoint ranges and stream one range at a time through the same
+compiled program:
+
+- every pass reuses ONE static-shape XLA program (chunk capacities are
+  maxed over passes, so nothing recompiles);
+- because ranges partition the key domain, a join pass only needs that
+  range's rows from BOTH sides, and per-pass group-by results are FINAL —
+  concatenation replaces the cross-pass combine a hash split would need;
+- the host holds the full inputs (numpy); each pass uploads ~1/P of the
+  data, so device residency is bounded by the pass size, not the input.
+
+This is the single-chip rung of the 1B-row ladder in BASELINE.md; the
+multi-chip rungs shard each pass over the mesh with the existing
+distributed operators.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import column as colmod
+from .config import JoinType
+from .ops import groupby as groupby_mod
+from .ops import join as join_mod
+from .ops.groupby import AggOp
+from .utils import pow2ceil
+
+
+def key_range_bounds(lo: int, hi: int, passes: int) -> List[Tuple[int, int]]:
+    """Split [lo, hi) into ``passes`` near-equal [start, stop) intervals."""
+    if passes < 1:
+        raise ValueError(f"passes must be >= 1, got {passes}")
+    span = hi - lo
+    edges = [lo + (span * p) // passes for p in range(passes)] + [hi]
+    return [(edges[p], edges[p + 1]) for p in range(passes)]
+
+
+def _compress(arrays: Sequence[np.ndarray], key: np.ndarray,
+              lo: int, hi: int) -> List[np.ndarray]:
+    mask = (key >= lo) & (key < hi)
+    return [a[mask] for a in arrays]
+
+
+def chunked_join_groupby(lk: np.ndarray, lv: np.ndarray,
+                         rk: np.ndarray, rv: np.ndarray,
+                         passes: int, algo: str = "sort",
+                         aggs: Tuple[Tuple[int, AggOp], ...] = (
+                             (1, AggOp.SUM), (3, AggOp.MEAN))):
+    """INNER join on int keys + group-by over key, in ``passes`` key-range
+    passes.  Returns (result dict of host arrays, stats dict).
+
+    The per-pass body is exactly the single-program bench pipeline
+    (key_grouped join feeding the sort-free pipeline group-by); this
+    driver adds the streaming shell around it.  Matches the scaling intent
+    of the reference's rank-partitioned join (docs/docs/arch.md:146-162)
+    with ranges instead of ranks.
+    """
+    t_plan0 = time.perf_counter()
+    if lk.size == 0 and rk.size == 0:
+        bounds = [(0, 1)]
+        passes = 1
+    else:
+        kmin = int(min(lk.min() if lk.size else rk.min(),
+                       rk.min() if rk.size else lk.min()))
+        kmax = int(max(lk.max() if lk.size else rk.max(),
+                       rk.max() if rk.size else lk.max()))
+        passes = min(passes, kmax + 1 - kmin)  # >= 1 distinct key per pass
+        bounds = key_range_bounds(kmin, kmax + 1, passes)
+
+    # chunk capacity from an O(n) histogram (no materialization): every
+    # pass then runs the same compiled program.  Chunks are compressed
+    # lazily per pass, so peak host memory is inputs + ONE chunk and only
+    # the pass in flight is device-resident — the point of out-of-core.
+    edges = np.asarray([b[0] for b in bounds] + [bounds[-1][1]], np.int64)
+    counts_l = np.histogram(lk, bins=edges)[0] if lk.size else np.zeros(passes)
+    counts_r = np.histogram(rk, bins=edges)[0] if rk.size else np.zeros(passes)
+    cap = pow2ceil(int(max(8, counts_l.max(initial=0),
+                           counts_r.max(initial=0))))
+
+    def _pad_cols(k: np.ndarray, v: np.ndarray):
+        return (colmod.from_numpy(k, capacity=cap),
+                colmod.from_numpy(v, capacity=cap))
+
+    def _device_chunk(lo: int, hi: int):
+        cl = _compress((lk, lv), lk, lo, hi)
+        cr = _compress((rk, rv), rk, lo, hi)
+        return (_pad_cols(*cl), jnp.asarray(cl[0].size, jnp.int32),
+                _pad_cols(*cr), jnp.asarray(cr[0].size, jnp.int32))
+
+    # pass 1 over the ladder: exact join sizes (the reference's two-pass
+    # builder Reserve, join_utils.cpp) -> one static output capacity
+    m_max = 0
+    for lo, hi in bounds:
+        cols_l, cnt_l, cols_r, cnt_r = _device_chunk(lo, hi)
+        m = int(join_mod.join_row_count(cols_l, cnt_l, cols_r, cnt_r,
+                                        (0,), (0,), JoinType.INNER, algo))
+        m_max = max(m_max, m)
+        del cols_l, cols_r  # free device buffers before the next pass
+    out_cap = pow2ceil(max(8, m_max))
+
+    @jax.jit
+    def pipeline(cl, cnt_l, cr, cnt_r):
+        joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
+                                         (0,), (0,), JoinType.INNER, out_cap,
+                                         algo, key_grouped=True)
+        gcols, g = groupby_mod.pipeline_groupby(joined, jm, (0,), aggs, 0)
+        return tuple(c.data for c in gcols), tuple(c.validity for c in gcols), g
+
+    # compile + warm on the first range so run_seconds is steady-state
+    args0 = _device_chunk(*bounds[0])
+    jax.block_until_ready(pipeline(*args0))
+    del args0
+    t_plan = time.perf_counter() - t_plan0
+
+    # streaming passes: compress, upload, run, fetch that range's final
+    # groups; host scan + upload + compute + download all land in
+    # run_seconds (the honest out-of-core cost — rows/sec includes the
+    # host<->device stream)
+    t_run0 = time.perf_counter()
+    outs: List[List[np.ndarray]] = []
+    total_groups = 0
+    for lo, hi in bounds:
+        cols_l, cnt_l, cols_r, cnt_r = _device_chunk(lo, hi)
+        data, _valid, g = jax.device_get(pipeline(cols_l, cnt_l, cols_r, cnt_r))
+        g = int(g)
+        total_groups += g
+        outs.append([np.asarray(d[:g]) for d in data])
+        del cols_l, cols_r
+    t_run = time.perf_counter() - t_run0
+
+    ncols = len(outs[0])
+    result = {
+        "key": np.concatenate([o[0] for o in outs]),
+    }
+    for j in range(1, ncols):
+        result[f"agg{j - 1}"] = np.concatenate([o[j] for o in outs])
+    stats = {
+        "passes": passes, "chunk_cap": cap, "out_cap": out_cap,
+        "groups": total_groups, "plan_seconds": t_plan,
+        "run_seconds": t_run,
+    }
+    return result, stats

@@ -1,0 +1,100 @@
+"""Out-of-core key-range-chunked join+groupby (cylon_tpu/exec.py).
+
+The reference scales by adding ranks (docs/docs/arch.md:146-162); the
+single-chip analog streams disjoint key ranges through one compiled
+program.  Correctness contract: pass concatenation == the unchunked
+pipeline == pandas merge+groupby.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu.exec import chunked_join_groupby, key_range_bounds
+
+
+def _pandas_golden(lk, lv, rk, rv):
+    j = pd.DataFrame({"k": lk, "a": lv}).merge(
+        pd.DataFrame({"k": rk, "b": rv}), on="k", how="inner")
+    return (j.groupby("k").agg(sum_a=("a", "sum"), mean_b=("b", "mean"))
+            .reset_index().sort_values("k").reset_index(drop=True))
+
+
+def _check(lk, lv, rk, rv, passes, rtol=1e-5):
+    # rtol scales with group size: f32 pairwise-summation error over a
+    # G-row group is ~sqrt(G)*eps relative, so million-row skew groups
+    # legitimately differ from the pandas golden in the 1e-4 range
+    res, stats = chunked_join_groupby(lk, lv, rk, rv, passes)
+    g = _pandas_golden(lk, lv, rk, rv)
+    order = np.argsort(res["key"], kind="stable")
+    np.testing.assert_array_equal(res["key"][order], g["k"].to_numpy())
+    np.testing.assert_allclose(res["agg0"][order], g["sum_a"].to_numpy(),
+                               rtol=rtol, atol=1e-6)
+    np.testing.assert_allclose(res["agg1"][order], g["mean_b"].to_numpy(),
+                               rtol=rtol, atol=1e-6)
+    assert stats["groups"] == len(g)
+    return stats
+
+
+def test_key_range_bounds_cover_domain():
+    b = key_range_bounds(3, 103, 7)
+    assert b[0][0] == 3 and b[-1][1] == 103
+    assert all(b[i][1] == b[i + 1][0] for i in range(6))
+    assert all(hi > lo for lo, hi in b)
+
+
+def test_key_range_bounds_rejects_zero_passes():
+    with pytest.raises(ValueError):
+        key_range_bounds(0, 10, 0)
+
+
+@pytest.mark.parametrize("passes", [1, 4, 7])
+def test_chunked_matches_pandas(rng, passes):
+    n = 50_000
+    lk = rng.integers(0, n, n).astype(np.int32)
+    lv = rng.random(n).astype(np.float32)
+    rk = rng.integers(0, n, n).astype(np.int32)
+    rv = rng.random(n).astype(np.float32)
+    stats = _check(lk, lv, rk, rv, passes)
+    assert stats["passes"] == passes
+
+
+def test_chunked_skewed_keys(rng):
+    """Heavy skew: one pass carries most rows; capacity must still hold."""
+    n = 20_000
+    lk = np.where(rng.random(n) < 0.7, 5, rng.integers(0, 1000, n)) \
+        .astype(np.int32)
+    lv = rng.random(n).astype(np.float32)
+    rk = rng.integers(0, 1000, n).astype(np.int32)
+    rv = rng.random(n).astype(np.float32)
+    _check(lk, lv, rk, rv, 8, rtol=1e-3)
+
+
+def test_chunked_hash_algo(rng):
+    n = 10_000
+    lk = rng.integers(0, n, n).astype(np.int32)
+    rk = rng.integers(0, n, n).astype(np.int32)
+    lv = rng.random(n).astype(np.float32)
+    rv = rng.random(n).astype(np.float32)
+    res, _ = chunked_join_groupby(lk, lv, rk, rv, 4, algo="hash")
+    g = _pandas_golden(lk, lv, rk, rv)
+    order = np.argsort(res["key"], kind="stable")
+    np.testing.assert_array_equal(res["key"][order], g["k"].to_numpy())
+
+
+def test_chunked_empty_inputs():
+    z_i = np.zeros(0, np.int32)
+    z_f = np.zeros(0, np.float32)
+    res, stats = chunked_join_groupby(z_i, z_f, z_i, z_f, 4)
+    assert stats["groups"] == 0
+    assert res["key"].size == 0
+
+
+def test_chunked_narrow_key_domain(rng):
+    """More passes than distinct keys: passes clamp, result stays right."""
+    n = 5_000
+    lk = rng.integers(0, 3, n).astype(np.int32)
+    rk = rng.integers(0, 3, n).astype(np.int32)
+    lv = rng.random(n).astype(np.float32)
+    rv = rng.random(n).astype(np.float32)
+    stats = _check(lk, lv, rk, rv, 16, rtol=5e-3)
+    assert stats["passes"] <= 3
