@@ -1,0 +1,17 @@
+#!/bin/bash
+# Poll the axon tunnel; the moment it serves, run the measurement battery
+# once and exit.  Outages last hours (see PERF.md), so this is the way to
+# catch a window without burning attention on manual probes.
+# Usage: tools/tpu_watch.sh [out_dir] [poll_seconds]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/battery}
+POLL=${2:-600}
+while true; do
+    if timeout 90 python bench.py --worker probe >/dev/null 2>&1; then
+        echo "[watch $(date +%H:%M:%S)] tunnel alive; firing battery"
+        exec tools/tpu_battery.sh "$OUT"
+    fi
+    echo "[watch $(date +%H:%M:%S)] tunnel down; sleeping ${POLL}s"
+    sleep "$POLL"
+done
