@@ -20,6 +20,10 @@
 #include <string>
 #include <vector>
 
+// the published foreign-binding contract; including it here makes the
+// compiler enforce header<->implementation prototype agreement
+#include "../include/cylon_tpu_c.h"
+
 namespace {
 
 struct CtColumn {

@@ -1,0 +1,101 @@
+/* Foreign-language consumer of the cylon_tpu native binding surface.
+ *
+ * Plays the role of the reference's Java binding
+ * (java/src/main/java/org/cylondata/cylon/Table.java:275-293 +
+ * java/src/main/native/src/Table.cpp): a non-Python, non-C++-internal
+ * host that builds tables through the raw-buffer builder, enumerates the
+ * registry, and reads columns back zero-copy — all through the C ABI in
+ * cylon_tpu/native/include/cylon_tpu_c.h.
+ *
+ * Build+run (tests/test_native.py::test_c_consumer_builds_and_reads
+ * does this):
+ *   gcc -O2 -o consumer consumer.c -L<libdir> -lcylon_tpu -Wl,-rpath,<libdir>
+ *   ./consumer
+ * Prints PASS lines and exits 0 on success.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "cylon_tpu_c.h"
+
+#define CHECK(cond, msg)                                   \
+  do {                                                     \
+    if (!(cond)) {                                         \
+      fprintf(stderr, "FAIL: %s (line %d)\n", msg, __LINE__); \
+      return 1;                                            \
+    }                                                      \
+    printf("PASS: %s\n", msg);                             \
+  } while (0)
+
+int main(void) {
+  /* dtype codes from cylon_tpu.dtypes.Type: 8=INT64, 11=DOUBLE, 12=STRING
+   * (opaque to the registry; must only agree with the reading side) */
+  const int DT_INT64 = 8, DT_DOUBLE = 11, DT_STRING = 12;
+
+  int64_t ids[4] = {10, 20, 30, 40};
+  double vals[4] = {1.5, 2.5, 3.5, 4.5};
+  uint8_t valid[4] = {1, 1, 0, 1};
+  /* strings as a padded byte matrix (width 4) + per-row lengths — the
+   * same layout cylon_tpu Columns use on device */
+  char names[16] = {'a', 'b', 0, 0, 'c', 0, 0, 0,
+                    'l', 'o', 'n', 'g', 'x', 0, 0, 0};
+  int32_t lens[4] = {2, 1, 4, 1};
+
+  CHECK(ct_builder_begin("orders") == 0, "builder begin");
+  CHECK(ct_builder_begin("orders") == -1, "double begin rejected");
+  CHECK(ct_builder_add_column("orders", "id", DT_INT64, 8, 4, ids, NULL,
+                              NULL) == 0, "add int64 column");
+  CHECK(ct_builder_add_column("orders", "v", DT_DOUBLE, 8, 4, vals, valid,
+                              NULL) == 0, "add double column with validity");
+  CHECK(ct_builder_add_column("orders", "s", DT_STRING, 4, 4, names, NULL,
+                              lens) == 0, "add string column");
+  CHECK(ct_builder_add_column("orders", "bad", DT_INT64, 8, 7, ids, NULL,
+                              NULL) == -2, "row-count mismatch rejected");
+  CHECK(ct_registry_contains("orders") == 0, "not visible before finish");
+  CHECK(ct_builder_finish("orders") == 0, "builder finish");
+  CHECK(ct_registry_contains("orders") == 1, "visible after finish");
+
+  CHECK(ct_table_rows("orders") == 4, "row count");
+  CHECK(ct_table_ncols("orders") == 3, "column count");
+  CHECK(ct_table_rows("nope") == -1, "unknown id -> -1");
+
+  char name[32];
+  CHECK(ct_table_col_name("orders", 2, name, sizeof name) == 1 &&
+        strcmp(name, "s") == 0, "column name");
+
+  int32_t dtype, width, has_validity, has_lengths;
+  int64_t rows;
+  CHECK(ct_table_col_info("orders", 1, &dtype, &width, &rows, &has_validity,
+                          &has_lengths) == 0 &&
+        dtype == DT_DOUBLE && width == 8 && rows == 4 && has_validity == 1 &&
+        has_lengths == 0, "column info");
+
+  const int64_t* rid = (const int64_t*)ct_table_col_data("orders", 0);
+  CHECK(rid && rid[0] == 10 && rid[3] == 40, "int64 data round-trip");
+  const double* rv = (const double*)ct_table_col_data("orders", 1);
+  CHECK(rv && rv[1] == 2.5, "double data round-trip");
+  const uint8_t* rvd = ct_table_col_validity("orders", 1);
+  CHECK(rvd && rvd[2] == 0 && rvd[3] == 1, "validity round-trip");
+  CHECK(ct_table_col_validity("orders", 0) == NULL, "absent validity NULL");
+  const int32_t* rl = ct_table_col_lengths("orders", 2);
+  const char* rs = (const char*)ct_table_col_data("orders", 2);
+  CHECK(rl && rs && rl[2] == 4 && memcmp(rs + 2 * 4, "long", 4) == 0,
+        "string matrix + lengths round-trip");
+
+  CHECK(ct_builder_begin("t2") == 0 && ct_builder_finish("t2") == 0,
+        "second table");
+  CHECK(ct_registry_size() == 2, "registry size");
+  char buf[64];
+  int64_t need = ct_registry_ids(buf, sizeof buf);
+  CHECK(need == (int64_t)strlen("orders\nt2") &&
+        strcmp(buf, "orders\nt2") == 0, "registry ids enumeration");
+
+  CHECK(ct_registry_remove("orders") == 0 &&
+        ct_registry_contains("orders") == 0, "remove");
+  ct_registry_clear();
+  CHECK(ct_registry_size() == 0, "clear");
+
+  printf("C consumer: ALL PASS\n");
+  return 0;
+}

@@ -245,3 +245,30 @@ def test_header_only_table(tmp_path):
     t = Table.from_csv(p)
     assert t.row_count == 0
     assert t.column_names == ["a", "b"]
+
+
+def test_c_consumer_builds_and_reads(tmp_path):
+    """A second-language (C) host drives the registry + builder through the
+    published C ABI header — the counterpart of the reference's Java
+    binding (java/src/main/native/src/Table.cpp over table_api.hpp)."""
+    import subprocess
+    import sys
+
+    from cylon_tpu.native import build as native_build
+
+    lib = native_build.build()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(root, "examples", "c_consumer", "consumer.c")
+    inc = os.path.join(root, "cylon_tpu", "native", "include")
+    exe = tmp_path / "consumer"
+    cc = os.environ.get("CC", "gcc")
+    compile_proc = subprocess.run(
+        [cc, "-O2", "-std=c11", "-o", str(exe), src, f"-I{inc}",
+         f"-L{os.path.dirname(lib)}", "-lcylon_tpu",
+         f"-Wl,-rpath,{os.path.dirname(lib)}"],
+        capture_output=True, text=True)
+    assert compile_proc.returncode == 0, compile_proc.stderr
+    run_proc = subprocess.run([str(exe)], capture_output=True, text=True,
+                              timeout=60)
+    assert run_proc.returncode == 0, run_proc.stdout + run_proc.stderr
+    assert "ALL PASS" in run_proc.stdout
