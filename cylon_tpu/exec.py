@@ -51,6 +51,29 @@ def _compress(arrays: Sequence[np.ndarray], key: np.ndarray,
     return [a[mask] for a in arrays]
 
 
+def _plan_passes(lk: np.ndarray, rk: np.ndarray, passes: int):
+    """Shared pass planning for both out-of-core rungs: key-range bounds
+    (clamped to >= 1 distinct key per pass) plus per-pass row counts from
+    an O(n) histogram — no chunk materialization.
+
+    Returns (bounds, passes, counts_l, counts_r).
+    """
+    if lk.size == 0 and rk.size == 0:
+        bounds = [(0, 1)]
+        passes = 1
+    else:
+        kmin = int(min(lk.min() if lk.size else rk.min(),
+                       rk.min() if rk.size else lk.min()))
+        kmax = int(max(lk.max() if lk.size else rk.max(),
+                       rk.max() if rk.size else lk.max()))
+        passes = min(passes, kmax + 1 - kmin)
+        bounds = key_range_bounds(kmin, kmax + 1, passes)
+    edges = np.asarray([b[0] for b in bounds] + [bounds[-1][1]], np.int64)
+    counts_l = np.histogram(lk, bins=edges)[0] if lk.size else np.zeros(passes)
+    counts_r = np.histogram(rk, bins=edges)[0] if rk.size else np.zeros(passes)
+    return bounds, passes, counts_l, counts_r
+
+
 def chunked_join_groupby(lk: np.ndarray, lv: np.ndarray,
                          rk: np.ndarray, rv: np.ndarray,
                          passes: int, algo: str = "sort",
@@ -66,24 +89,11 @@ def chunked_join_groupby(lk: np.ndarray, lv: np.ndarray,
     with ranges instead of ranks.
     """
     t_plan0 = time.perf_counter()
-    if lk.size == 0 and rk.size == 0:
-        bounds = [(0, 1)]
-        passes = 1
-    else:
-        kmin = int(min(lk.min() if lk.size else rk.min(),
-                       rk.min() if rk.size else lk.min()))
-        kmax = int(max(lk.max() if lk.size else rk.max(),
-                       rk.max() if rk.size else lk.max()))
-        passes = min(passes, kmax + 1 - kmin)  # >= 1 distinct key per pass
-        bounds = key_range_bounds(kmin, kmax + 1, passes)
-
-    # chunk capacity from an O(n) histogram (no materialization): every
-    # pass then runs the same compiled program.  Chunks are compressed
-    # lazily per pass, so peak host memory is inputs + ONE chunk and only
-    # the pass in flight is device-resident — the point of out-of-core.
-    edges = np.asarray([b[0] for b in bounds] + [bounds[-1][1]], np.int64)
-    counts_l = np.histogram(lk, bins=edges)[0] if lk.size else np.zeros(passes)
-    counts_r = np.histogram(rk, bins=edges)[0] if rk.size else np.zeros(passes)
+    # chunk capacity maxed over passes: every pass runs the same compiled
+    # program.  Chunks are compressed lazily per pass, so peak host memory
+    # is inputs + ONE chunk and only the pass in flight is device-resident
+    # — the point of out-of-core.
+    bounds, passes, counts_l, counts_r = _plan_passes(lk, rk, passes)
     cap = pow2ceil(int(max(8, counts_l.max(initial=0),
                            counts_r.max(initial=0))))
 
@@ -150,3 +160,52 @@ def chunked_join_groupby(lk: np.ndarray, lv: np.ndarray,
         "run_seconds": t_run,
     }
     return result, stats
+
+
+def chunked_distributed_join_groupby(lk: np.ndarray, lv: np.ndarray,
+                                     rk: np.ndarray, rv: np.ndarray,
+                                     passes: int, ctx,
+                                     agg: Dict | None = None):
+    """The multi-chip rung of the out-of-core ladder: every key-range pass
+    is SHARDED OVER ``ctx``'s device mesh and runs the public distributed
+    operators (shuffle-both join + two-phase group-by), so total capacity
+    is passes x mesh-HBM instead of passes x one chip.
+
+    Ranges still partition the key domain, so per-pass group-bys remain
+    final and cross-pass work is host concatenation — the composition of
+    the reference's rank scaling (docs/docs/arch.md:146-162) with the
+    range streaming of :func:`chunked_join_groupby`.
+
+    Returns (pandas-convertible dict of host arrays, stats).
+    """
+    from .table import Table
+
+    # join output names: the colliding key becomes l_k/r_k, value columns
+    # keep their names (join_utils.cpp build_final_table naming)
+    if agg is None:
+        agg = {"a": ["sum"], "b": ["mean"]}
+    t0 = time.perf_counter()
+    bounds, passes, counts_l, counts_r = _plan_passes(lk, rk, passes)
+    # same per-shard capacity every pass -> the shard_map program caches hit
+    world = ctx.GetWorldSize()
+    shard_cap = pow2ceil(int(max(8, -(-int(counts_l.max(initial=0)) // world),
+                                 -(-int(counts_r.max(initial=0)) // world))))
+    cap = shard_cap * world
+
+    frames = []
+    total_groups = 0
+    for lo, hi in bounds:
+        cl = _compress((lk, lv), lk, lo, hi)
+        cr = _compress((rk, rv), rk, lo, hi)
+        left = Table.from_numpy(["k", "a"], cl, ctx=ctx, capacity=cap)
+        right = Table.from_numpy(["k", "b"], cr, ctx=ctx, capacity=cap)
+        j = left.distributed_join(right, on="k", how="inner")
+        g = j.groupby("l_k", agg)
+        frames.append(g.to_numpy())
+        total_groups += g.row_count
+    out = {name: np.concatenate([f[name] for f in frames])
+           for name in frames[0]}
+    stats = {"passes": passes, "world": world, "shard_cap": shard_cap,
+             "groups": total_groups,
+             "total_seconds": time.perf_counter() - t0}
+    return out, stats

@@ -314,12 +314,40 @@ def exchange_bytes(ctx, per_target: Sequence[np.ndarray]) -> List[np.ndarray]:
              for b in row] for row in per_target]
     maxlen = max((r.size for row in raws for r in row), default=0)
     maxlen = max(maxlen, 1)
-    sendbuf = np.zeros((world, world, maxlen), np.uint8)
-    lengths = np.zeros((world, world), np.int32)
-    for r, row in enumerate(raws):
-        for t, raw in enumerate(row):
-            sendbuf[r, t, :raw.size] = raw
-            lengths[r, t] = raw.size
+
+    # per-shard staging via make_array_from_callback: the PADDED send
+    # matrix is built one rank-slice at a time instead of as one dense
+    # [world, world, maxlen] host allocation.  (This function remains a
+    # single-host parity shim: `raws` conversion and the np.asarray
+    # readback below still touch every rank — the production multi-host
+    # data path is parallel/shuffle.py.)  Device-side the padded matrix is
+    # inherent to the uniform-chunk lax.all_to_all — the shim's documented
+    # bucket-padding bound.
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(ctx.mesh, P(PARTITION_AXIS))
+
+    def _send_cb(index):
+        sl = index[0]
+        lo = sl.start or 0
+        hi = sl.stop if sl.stop is not None else world
+        buf = np.zeros((hi - lo, world, maxlen), np.uint8)
+        for i, r in enumerate(range(lo, hi)):
+            for t, raw in enumerate(raws[r]):
+                buf[i, t, :raw.size] = raw
+        return buf
+
+    def _len_cb(index):
+        sl = index[0]
+        lo = sl.start or 0
+        hi = sl.stop if sl.stop is not None else world
+        return np.asarray(
+            [[raws[r][t].size for t in range(world)]
+             for r in range(lo, hi)], np.int32)
+
+    sendbuf = jax.make_array_from_callback((world, world, maxlen), sharding,
+                                           _send_cb)
+    lengths = jax.make_array_from_callback((world, world), sharding, _len_cb)
 
     def fn(chunk, lens):
         return (collectives.all_to_all(chunk[0]),
@@ -328,7 +356,7 @@ def exchange_bytes(ctx, per_target: Sequence[np.ndarray]) -> List[np.ndarray]:
     spec = P(PARTITION_AXIS)
     out, out_lens = jax.jit(jax.shard_map(
         fn, mesh=ctx.mesh, in_specs=spec, out_specs=spec,
-        check_vma=False))(jnp.asarray(sendbuf), jnp.asarray(lengths))
+        check_vma=False))(sendbuf, lengths)
     out = np.asarray(out).reshape(world, world, maxlen)
     out_lens = np.asarray(out_lens).reshape(world, world)
     return [[out[r, s, :out_lens[r, s]] for s in range(world)]
