@@ -66,6 +66,24 @@ def main() -> int:
     l["z"] = np.arange(len(pl), dtype=np.int64)
     assert int(l.sum("z")) == int(np.arange(len(pl), dtype=np.int64).sum())
 
+    # per-shard write is GATHER-FREE: this process must write exactly its
+    # own 4 shards (reference: rank-local WriteCSV, table.cpp:243-256)
+    import glob
+    import tempfile
+
+    outdir = os.path.join(tempfile.gettempdir(), f"mh_shards_{port}")
+    os.makedirs(outdir, exist_ok=True)
+    shards = l._addressable_host_shards()
+    assert [sid for sid, _, _ in shards] == list(range(4 * pid, 4 * pid + 4)), \
+        [sid for sid, _, _ in shards]
+    l.to_csv(os.path.join(outdir, "part_{shard}.csv"), per_shard=True)
+    mine = sorted(glob.glob(os.path.join(outdir, "part_*.csv")))
+    ctx.Barrier()  # wait until both processes finished writing
+    allf = sorted(glob.glob(os.path.join(outdir, "part_*.csv")))
+    assert len(mine) >= 4 and len(allf) == 8, (len(mine), len(allf))
+    total = sum(len(pd.read_csv(f)) for f in allf)
+    assert total == len(pl), (total, len(pl))
+
     print(f"proc {pid}/{nprocs} OK: join={exp} groups={g.row_count}",
           flush=True)
     return 0
