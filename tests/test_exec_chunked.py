@@ -122,3 +122,13 @@ def test_chunked_distributed_matches_pandas(ctx8, rng, passes):
                                rtol=1e-4)
     assert stats["groups"] == len(g)
     assert stats["world"] == 8
+
+
+def test_chunked_negative_int64_keys(rng):
+    """Signed/64-bit key domains chunk correctly (bounds span negatives)."""
+    n = 8_000
+    lk = rng.integers(-5000, 5000, n).astype(np.int64)
+    rk = rng.integers(-5000, 5000, n).astype(np.int64)
+    lv = rng.random(n).astype(np.float32)
+    rv = rng.random(n).astype(np.float32)
+    _check(lk, lv, rk, rv, 6, rtol=1e-4)
