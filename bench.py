@@ -422,7 +422,9 @@ class _Bench:
         the budget allows; smaller sizes still anchor vs_baseline since
         rows/sec is size-intensive — the artifact reports baseline_rows)."""
         pcache = self.cache.setdefault("pandas", {})
-        for r in [rows, 1 << 23, 1 << 22]:
+        # pandas at out-of-core sizes (>2^26) is pointless pain: rows/sec
+        # is size-intensive, so anchor at the largest single-program size
+        for r in [min(rows, 1 << 26), 1 << 23, 1 << 22]:
             if r > rows:
                 continue
             if str(r) in pcache:

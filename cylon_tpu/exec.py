@@ -90,9 +90,11 @@ def chunked_join_groupby(lk: np.ndarray, lv: np.ndarray,
     """
     t_plan0 = time.perf_counter()
     # chunk capacity maxed over passes: every pass runs the same compiled
-    # program.  Chunks are compressed lazily per pass, so peak host memory
-    # is inputs + ONE chunk and only the pass in flight is device-resident
-    # — the point of out-of-core.
+    # program.  Chunks are compressed lazily per pass (peak host memory is
+    # inputs + one chunk); device residency is bounded by the pass in
+    # flight plus, when prefetch is on, the NEXT pass's staged input
+    # columns (~20 B/input-row on top of the pipeline's 84 — see the
+    # PERF.md budget model; still inside HBM at the minimum pass count).
     bounds, passes, counts_l, counts_r = _plan_passes(lk, rk, passes)
     cap = pow2ceil(int(max(8, counts_l.max(initial=0),
                            counts_r.max(initial=0))))
@@ -132,20 +134,31 @@ def chunked_join_groupby(lk: np.ndarray, lv: np.ndarray,
     del args0
     t_plan = time.perf_counter() - t_plan0
 
-    # streaming passes: compress, upload, run, fetch that range's final
-    # groups; host scan + upload + compute + download all land in
-    # run_seconds (the honest out-of-core cost — rows/sec includes the
-    # host<->device stream)
+    # streaming passes, DOUBLE-BUFFERED by default: pass p's pipeline is
+    # dispatched asynchronously, then pass p+1's host compression + upload
+    # overlap with it before the blocking device_get.  Host scan + upload
+    # + compute + download all land in run_seconds (the honest out-of-core
+    # cost — rows/sec includes the host<->device stream).
+    # CYLON_TPU_PREFETCH=0 reverts to strictly serial single-chunk
+    # residency for HBM-starved configurations.
+    import os
+
+    prefetch = os.environ.get("CYLON_TPU_PREFETCH", "1") != "0"
     t_run0 = time.perf_counter()
     outs: List[List[np.ndarray]] = []
     total_groups = 0
-    for lo, hi in bounds:
-        cols_l, cnt_l, cols_r, cnt_r = _device_chunk(lo, hi)
-        data, _valid, g = jax.device_get(pipeline(cols_l, cnt_l, cols_r, cnt_r))
+    nxt = _device_chunk(*bounds[0]) if prefetch else None
+    for p in range(len(bounds)):
+        cur = nxt if prefetch else _device_chunk(*bounds[p])
+        fut = pipeline(*cur)  # async dispatch
+        nxt = (_device_chunk(*bounds[p + 1])
+               if prefetch and p + 1 < len(bounds) else None)
+        data, _valid, g = jax.device_get(fut)
         g = int(g)
         total_groups += g
         outs.append([np.asarray(d[:g]) for d in data])
-        del cols_l, cols_r
+        del cur, fut
+    del nxt
     t_run = time.perf_counter() - t_run0
 
     ncols = len(outs[0])
