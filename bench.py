@@ -96,27 +96,49 @@ def _measure(rows: int) -> float:
     algo = os.environ.get("CYLON_BENCH_ALGO", "sort")  # sort|hash join kernel
 
     # size the join output once (exact count, like the reference's two-pass
-    # builder Reserve); steady-state reps reuse the capacity
-    m = int(join_mod.join_row_count(cols_l, count, cols_r, count,
-                                    (0,), (0,), JoinType.INNER, algo))
+    # builder Reserve); steady-state reps reuse the capacity.  The count is
+    # DETERMINISTIC given (SEED, rows), so a verified entry is cached
+    # across runs — one fewer full-size program through a flaky tunnel.
+    m = _cached_join_count(rows)
+    from_cache = m is not None
+    if m is None:
+        m = int(join_mod.join_row_count(cols_l, count, cols_r, count,
+                                        (0,), (0,), JoinType.INNER, algo))
     out_cap = _cap_round(m)
-    _log(f"rows={rows} join_count={m} out_cap={out_cap} algo={algo}")
+    _log(f"rows={rows} join_count={m} out_cap={out_cap} algo={algo} "
+         f"cached={from_cache}")
 
-    @jax.jit
-    def pipeline(cl, cnt_l, cr, cnt_r):
-        # key_grouped inner join emits equal keys adjacent, so the group-by
-        # is the sort-free boundary-scan pipeline kernel — one big sort in
-        # the whole program instead of two
-        joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
-                                          (0,), (0,), JoinType.INNER, out_cap,
-                                          algo, key_grouped=True)
-        gcols, g = groupby_mod.pipeline_groupby(
-            joined, jm, (0,), ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
-        return gcols[1].data, gcols[2].data, g, jm
+    def make_pipeline(cap: int):
+        @jax.jit
+        def pipeline(cl, cnt_l, cr, cnt_r):
+            # key_grouped inner join emits equal keys adjacent, so the
+            # group-by is the sort-free boundary-scan pipeline kernel — one
+            # big sort in the whole program instead of two
+            joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
+                                              (0,), (0,), JoinType.INNER,
+                                              cap, algo, key_grouped=True)
+            gcols, g = groupby_mod.pipeline_groupby(
+                joined, jm, (0,), ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
+            return gcols[1].data, gcols[2].data, g, jm
+        return pipeline
 
+    pipeline = make_pipeline(out_cap)
     out = pipeline(cols_l, count, cols_r, count)
     jax.block_until_ready(out)  # compile + warm-up
-    assert int(out[3]) == m <= out_cap
+    live = int(out[3])  # jm is the TRUE join count even when cap clipped
+    if live != m:
+        # only a stale cache entry can disagree; drop it, re-size, re-warm
+        assert from_cache, f"join_row_count {m} != pipeline count {live}"
+        _log(f"stale cached join count {m} != live {live}; re-sizing")
+        m = live
+        if _cap_round(live) != out_cap:
+            out_cap = _cap_round(live)
+            pipeline = make_pipeline(out_cap)
+            out = pipeline(cols_l, count, cols_r, count)
+            jax.block_until_ready(out)
+            assert int(out[3]) == m
+    _save_join_count(rows, m)  # verified by the live pipeline
+    assert m <= out_cap
 
     times = []
     for _ in range(REPS):
@@ -128,6 +150,48 @@ def _measure(rows: int) -> float:
     _log(f"times={['%.3f' % t for t in times]}")
     n_chips = 1  # the pipeline is a single-device jit program
     return (2 * rows) / dt / n_chips
+
+
+def _merge_save_cache(overlay: dict) -> None:
+    """The ONE cache writer: re-read disk, overlay the caller's keys
+    (map-valued keys merge entry-wise so parent and workers never clobber
+    each other's sizes), atomic replace.  Used by both the parent
+    (tpu/pandas) and workers (join_counts)."""
+    try:
+        with open(CACHE_PATH) as f:
+            disk = json.load(f)
+    except Exception:
+        disk = {}
+    for k, v in overlay.items():
+        if k in ("pandas", "join_counts") and isinstance(disk.get(k), dict) \
+                and isinstance(v, dict):
+            disk[k] = {**disk[k], **v}
+        else:
+            disk[k] = v
+    tmp = f"{CACHE_PATH}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(disk, f, indent=1)
+    os.replace(tmp, CACHE_PATH)
+
+
+def _cached_join_count(rows: int):
+    """Join count for (SEED, rows) from .bench_cache.json, if recorded.
+    INNER-join cardinality is independent of the join algorithm, so one
+    entry serves both; entries are only written after the live pipeline
+    verified them, and a stale one is dropped + re-measured in _measure."""
+    try:
+        with open(CACHE_PATH) as f:
+            return json.load(f).get("join_counts", {}).get(
+                f"{SEED}:{rows}")
+    except Exception:
+        return None
+
+
+def _save_join_count(rows: int, m: int) -> None:
+    try:
+        _merge_save_cache({"join_counts": {f"{SEED}:{rows}": m}})
+    except Exception as e:
+        _log(f"join-count cache save failed: {e}")
 
 
 def _measure_chunked(rows: int, passes: int, emit=None) -> float:
@@ -282,12 +346,12 @@ class _Bench:
 
     def save_cache(self) -> None:
         try:
-            # atomic replace: a SIGALRM/SIGTERM exit mid-dump must not
-            # truncate the cache that seeds the next outage round
-            tmp = CACHE_PATH + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self.cache, f, indent=1)
-            os.replace(tmp, CACHE_PATH)
+            # overlay ONLY parent-owned keys: workers write join_counts to
+            # the same file while this parent runs, and the startup
+            # snapshot in self.cache must never clobber them
+            overlay = {k: self.cache[k] for k in ("tpu", "pandas")
+                       if self.cache.get(k) is not None}
+            _merge_save_cache(overlay)
         except Exception as e:
             _log(f"cache save failed: {e}")
 
