@@ -172,3 +172,23 @@ def test_string_key_join_groupby_differential(ctx4, seed):
     assert list(got["s"]) == list(gg["s"])
     np.testing.assert_allclose(got["sum_v"], gg["sum_v"], rtol=1e-9)
     np.testing.assert_array_equal(got["count_v"], gg["count_v"])
+
+
+@pytest.mark.parametrize("seed", SEEDS[:8])
+def test_hash_algorithm_join_differential(ctx4, seed):
+    """The open-addressing hash-join family must agree with pandas (and
+    thus with the sort family) under the same random nulls/skew."""
+    rng = np.random.default_rng(7000 + seed)
+    how = ["inner", "left", "right", "outer"][seed % 4]
+    ldf, rdf = _rand_frame(rng), _rand_frame(rng)
+    t = _mk(ldf, ctx4).distributed_join(_mk(rdf, ctx4), on="k", how=how,
+                                        algorithm="hash")
+    g = ldf.merge(rdf, on="k", how=how, suffixes=("_l", "_r"))
+    got = t.to_pandas()
+    assert len(got) == len(g)
+    np.testing.assert_allclose(
+        np.sort(np.nan_to_num(got["l_v"].to_numpy(), nan=-7e9)),
+        np.sort(np.nan_to_num(g["v_l"].to_numpy(), nan=-7e9)), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.sort(np.nan_to_num(got["r_v"].to_numpy(), nan=-7e9)),
+        np.sort(np.nan_to_num(g["v_r"].to_numpy(), nan=-7e9)), rtol=1e-12)
