@@ -212,12 +212,34 @@ class LRUCache(dict):
             return val
         return default
 
+    def __getitem__(self, key):
+        # route through get() so bracket reads refresh recency too — a
+        # plain-dict __getitem__ would silently degrade the LRU to FIFO
+        sentinel = object()
+        val = self.get(key, sentinel)
+        if val is sentinel:
+            raise KeyError(key)
+        return val
+
     def __setitem__(self, key, value):
         if key in self:
             super().pop(key)
         super().__setitem__(key, value)
         while len(self) > self.maxsize:
             super().pop(next(iter(self)))
+
+    def setdefault(self, key, default=None):
+        sentinel = object()
+        val = self.get(key, sentinel)
+        if val is sentinel:
+            self[key] = default
+            return default
+        return val
+
+    def update(self, *args, **kwargs):
+        # honor the size bound and recency on bulk writes as well
+        for k, v in dict(*args, **kwargs).items():
+            self[k] = v
 
 
 def ctx_cache(ctx: CylonContext, name: str, maxsize: int | None = None) -> Dict:

@@ -171,6 +171,10 @@ def chunked_join_groupby(lk: np.ndarray, lv: np.ndarray,
         "passes": passes, "chunk_cap": cap, "out_cap": out_cap,
         "groups": total_groups, "plan_seconds": t_plan,
         "run_seconds": t_run,
+        # cold-run honesty (round-3 advice): the mandatory exact-sizing pass
+        # inside plan_seconds re-reads the whole input, so a throughput from
+        # run_seconds alone understates one-shot cost by ~one data pass
+        "total_seconds": t_plan + t_run,
     }
     return result, stats
 

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column
+from . import radix
 
 
 def pack_string_words(data: jax.Array) -> List[jax.Array]:
@@ -202,11 +203,20 @@ def lexsort_indices(operands: Sequence[jax.Array], capacity: int) -> Tuple[jax.A
             append(bits.astype(jnp.uint32), w)
         append(jnp.arange(capacity, dtype=jnp.uint32), idx_bits)
 
+        use_radix = radix.sort_mode() == "radix"
         if total_bits + idx_bits <= 32:  # everything landed in lo
-            s_lo = jax.lax.sort(lo, is_stable=False)  # keys are unique
+            if use_radix:
+                _, s_lo = radix.radix_sort_packed(
+                    None, lo, idx_bits, idx_bits + total_bits)
+            else:
+                s_lo = jax.lax.sort(lo, is_stable=False)  # keys are unique
             perm = (s_lo & jnp.uint32((1 << idx_bits) - 1)).astype(jnp.int32)
             return perm, [s_lo >> jnp.uint32(idx_bits)]
-        s_hi, s_lo = jax.lax.sort((hi, lo), num_keys=2, is_stable=False)
+        if use_radix:
+            s_hi, s_lo = radix.radix_sort_packed(
+                hi, lo, idx_bits, idx_bits + total_bits)
+        else:
+            s_hi, s_lo = jax.lax.sort((hi, lo), num_keys=2, is_stable=False)
         perm = (s_lo & jnp.uint32((1 << idx_bits) - 1)).astype(jnp.int32)
         return perm, [s_hi, s_lo >> jnp.uint32(idx_bits)]
     packed = _pack_encoded(enc)

@@ -1,0 +1,151 @@
+"""LSD radix sort over packed key words — the bandwidth-bound alternative
+to ``lax.sort`` for the ≤64-bit packed fast path in :mod:`.keys`.
+
+Why: XLA lowers a TPU ``lax.sort`` to a comparator network whose depth
+grows ~log²(n); at the bench shape (2^27 combined rows) that network is
+the pipeline's dominant cost (PERF.md: the 84 B/row HBM peak is
+sort-region-dominated, replacing the reference's hot sort loops
+join/join.cpp:78-257 and util/sort.hpp).  A least-significant-digit radix
+sort is O(n) passes over the data: per significant key bit, one stable
+1-bit counting split (a cumsum plus one permuting scatter).  The packed
+fast-path encoding makes the digit count SMALL: only the significant key
+bits (e.g. padding + validity + 32-bit key = 34) are processed — the
+embedded row-index bits that make keys unique are skipped entirely,
+because counting splits are stable and therefore preserve the index
+order that ``lax.sort`` would have established by comparing them.
+
+The inclusive scan inside each split is itself a log-depth network if
+left to XLA, so ``_cumsum_i32`` reshapes to [blocks, B] and rides the
+MXU: an inclusive within-block scan is one f32 matmul against an
+upper-triangular ones matrix (counts ≤ B « 2^24 stay exact in f32), and
+the cross-block offset is a tiny host-size scan.  Total per-pass traffic
+is a handful of linear sweeps, so the whole sort is ~34 linear passes
+instead of ~400 comparator stages.
+
+Env knobs (A/B'd by the TPU battery):
+- CYLON_TPU_SORT=radix     switch lexsort's packed fast path to this sort
+- CYLON_TPU_RADIX_BITS=d   digits wider than 1 bit (2^d cumsums per pass
+                           via the counting scan, so scan traffic grows
+                           as (2^d/d)·bits while scatter passes shrink as
+                           bits/d; default 1 — the scan-optimal point)
+- CYLON_TPU_RADIX_SCAN=xla use jnp.cumsum instead of the matmul scan
+"""
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK = 256  # matmul-scan block edge: one MXU tile, counts ≤ 256 exact in f32
+
+
+def sort_mode() -> str:
+    """Which packed-fast-path sort to use ("cmp" = lax.sort, "radix")."""
+    return os.environ.get("CYLON_TPU_SORT", "cmp")
+
+
+def radix_bits() -> int:
+    try:
+        d = int(os.environ.get("CYLON_TPU_RADIX_BITS", "1"))
+    except ValueError:
+        d = 1
+    return max(1, min(d, 8))
+
+
+def _cumsum_i32(m: jax.Array) -> jax.Array:
+    """Inclusive cumsum of a bool/int mask as int32, O(n) HBM traffic.
+
+    Two-level: per-block inclusive scan via one [B,B] upper-triangular f32
+    matmul (MXU), plus an exclusive scan of the per-block sums (tiny).
+    Falls back to jnp.cumsum under CYLON_TPU_RADIX_SCAN=xla for A/B."""
+    if os.environ.get("CYLON_TPU_RADIX_SCAN") == "xla":
+        return jnp.cumsum(m.astype(jnp.int32))
+    n = m.shape[0]
+    if n < _BLOCK * 4 or n % _BLOCK:
+        return jnp.cumsum(m.astype(jnp.int32))
+    x = m.astype(jnp.float32).reshape(n // _BLOCK, _BLOCK)
+    tri = jnp.triu(jnp.ones((_BLOCK, _BLOCK), jnp.float32))  # k<=j upper incl.
+    within = jax.lax.dot_general(
+        x, tri, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [nb, B] inclusive scans
+    block_sums = within[:, -1].astype(jnp.int32)     # [nb]
+    offsets = jnp.cumsum(block_sums) - block_sums    # exclusive, tiny
+    return (within.astype(jnp.int32) + offsets[:, None]).reshape(n)
+
+
+def _extract_digit(hi: jax.Array, lo: jax.Array, shift: int,
+                   width: int) -> jax.Array:
+    """Bits [shift, shift+width) of the logical 64-bit (hi:lo) value, as
+    uint32.  All shift arithmetic is static (trace-time)."""
+    mask = jnp.uint32((1 << width) - 1)
+    if shift >= 32:
+        return (hi >> jnp.uint32(shift - 32)) & mask
+    if shift + width <= 32:
+        return (lo >> jnp.uint32(shift)) & mask
+    low_part = lo >> jnp.uint32(shift)          # top (32-shift) bits of lo
+    hi_bits = shift + width - 32                # bits taken from hi
+    high_part = (hi & jnp.uint32((1 << hi_bits) - 1)) << jnp.uint32(32 - shift)
+    return (high_part | low_part) & mask
+
+
+def _split_destinations(digit: jax.Array, width: int) -> jax.Array:
+    """Stable counting-sort destinations for one radix digit.
+
+    width == 1 uses the single-cumsum split (rank among set bits is
+    position minus rank among clear bits); wider digits run the counting
+    scan (one cumsum per digit value, unrolled at trace time — the same
+    shape as shuffle's _perm_by_target, whose alphabet is the mesh)."""
+    n = digit.shape[0]
+    if width == 1:
+        zero = digit == 0
+        c = _cumsum_i32(zero)                   # rank+1 among zeros
+        total_zero = c[-1]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        return jnp.where(zero, c - 1, total_zero + (iota - c))
+    dest = jnp.zeros((n,), jnp.int32)
+    base = jnp.zeros((), jnp.int32)
+    for v in range(1 << width):
+        sel = digit == v
+        c = _cumsum_i32(sel)
+        dest = jnp.where(sel, base + c - 1, dest)
+        base = base + c[-1]
+    return dest
+
+
+def _permute(dest: jax.Array, *arrays: jax.Array) -> Tuple[jax.Array, ...]:
+    """Apply the destination map as one scatter per array (dest is a
+    permutation — unique, in-bounds by construction)."""
+    out = []
+    for a in arrays:
+        out.append(jnp.zeros_like(a).at[dest].set(
+            a, unique_indices=True, indices_are_sorted=False,
+            mode="promise_in_bounds"))
+    return tuple(out)
+
+
+def radix_sort_packed(hi: jax.Array | None, lo: jax.Array,
+                      sig_lo: int, sig_hi: int) -> Tuple[jax.Array | None, jax.Array]:
+    """Stable LSD radix sort of the logical 64-bit values (hi:lo) — or
+    32-bit values when ``hi is None`` — by bits [sig_lo, sig_hi).
+
+    Bits below ``sig_lo`` (the embedded row index) are carried, not
+    sorted: pass stability preserves their pre-existing order, which is
+    exactly what sorting them would produce since they are unique and
+    initially ascending.  Returns the reordered (hi, lo)."""
+    d = radix_bits()
+    shift = sig_lo
+    while shift < sig_hi:
+        width = min(d, sig_hi - shift)
+        if hi is None:
+            digit = (lo >> jnp.uint32(shift)) & jnp.uint32((1 << width) - 1)
+        else:
+            digit = _extract_digit(hi, lo, shift, width)
+        dest = _split_destinations(digit, width)
+        if hi is None:
+            (lo,) = _permute(dest, lo)
+        else:
+            hi, lo = _permute(dest, hi, lo)
+        shift += width
+    return hi, lo

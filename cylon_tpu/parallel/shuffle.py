@@ -56,11 +56,12 @@ def _perm_by_target(targets: jax.Array, world: int) -> jax.Array:
     the program.
 
     Precondition: targets in [0, world] (world == padding).  Producers
-    (hash_targets/range_targets) guarantee it; the clip below makes an
-    out-of-range producer bug corrupt counts (visible downstream) instead of
-    silently colliding destinations into slot 0."""
+    (hash_targets/range_targets) guarantee it; out-of-range values — negative
+    included — are remapped to the PADDING bucket, so a producer bug drops
+    rows into padding (visible as count loss downstream) instead of silently
+    misrouting them to rank 0, a legitimate destination."""
     cap = targets.shape[0]
-    targets = jnp.clip(targets, 0, world)
+    targets = jnp.where((targets < 0) | (targets > world), world, targets)
     iota = jnp.arange(cap, dtype=jnp.int32)
     if world + 1 > 32:
         _, perm = jax.lax.sort((targets, iota), num_keys=1, is_stable=True)

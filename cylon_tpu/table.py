@@ -969,11 +969,19 @@ def _check_join_keys(left: Table, right: Table, cfg: JoinConfig) -> JoinConfig:
         raise CylonError(Code.Invalid, "left_on/right_on length mismatch")
     for li, ri in zip(cfg.left_on, cfg.right_on):
         lt, rt = left.columns[li].dtype, right.columns[ri].dtype
-        if dtypes.is_string_like(lt) != dtypes.is_string_like(rt):
+        # string keys only need to agree on string-likeness (widths are
+        # padded to match); everything else must match EXACTLY —
+        # concatenating an int64 key column with an int32 one silently
+        # promotes and mis-orders the packed sort operands (verified to
+        # corrupt join output).  The reference's typed comparators reject
+        # this at kernel dispatch (arrow_comparator.hpp); we reject at
+        # the API.
+        string_alike = dtypes.is_string_like(lt) and dtypes.is_string_like(rt)
+        if not string_alike and lt != rt:
             raise CylonError(
                 Code.Invalid,
                 f"join key type mismatch: {left.names[li]}:{lt} vs "
-                f"{right.names[ri]}:{rt}")
+                f"{right.names[ri]}:{rt} (cast the keys to a common type)")
     return cfg
 
 

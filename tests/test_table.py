@@ -80,6 +80,22 @@ def test_bad_column_raises(local_ctx):
         t.project([5])
 
 
+def test_join_numeric_key_dtype_mismatch_raises(local_ctx):
+    """int64-vs-int32 keys silently corrupted join output before round 4
+    (concat promoted, packed operands mis-ordered); must raise instead."""
+    a = Table.from_pandas(pd.DataFrame({"k": np.arange(5, dtype=np.int64),
+                                        "v": np.ones(5)}), ctx=local_ctx)
+    b = Table.from_pandas(pd.DataFrame({"k": np.arange(5, dtype=np.int32),
+                                        "w": np.ones(5)}), ctx=local_ctx)
+    with pytest.raises(CylonError, match="type mismatch"):
+        a.join(b, on="k", how="inner")
+    with pytest.raises(CylonError, match="type mismatch"):
+        a.join(b, on="k", how="inner", algorithm="hash")
+    # same dtype joins fine
+    j = a.join(a, on="k", how="inner")
+    assert j.row_count == 5
+
+
 def test_distributed_construction_and_gather(ctx4):
     n = 103
     df = pd.DataFrame({"a": np.arange(n), "b": np.arange(n) * 0.5})
