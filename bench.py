@@ -272,6 +272,7 @@ def _worker(backend: str, skip: int = 0) -> int:
                   and _prec.narrow() else "scatter")
         frag = {"value": value, "rows": rows, "backend": plat,
                 "algo": os.environ.get("CYLON_BENCH_ALGO", "sort"),
+                "sort_mode": os.environ.get("CYLON_TPU_SORT", "cmp"),
                 "segsum": segsum}
         if passes > 1:
             frag["passes"] = passes
@@ -415,6 +416,7 @@ class _Bench:
             "rows_per_side": r["rows"],
             "backend": r["backend"],
             "algo": r.get("algo", "sort"),
+            "sort_mode": r.get("sort_mode", "cmp"),
             "segsum": r.get("segsum", "scatter"),
             "source": source,
         }
@@ -446,6 +448,7 @@ class _Bench:
         cur = self.cache.get("tpu")
         if r["backend"] in ("tpu", "axon") and r.get("algo", "sort") == "sort" \
                 and r.get("segsum", "scatter") == "scatter" \
+                and r.get("sort_mode", "cmp") == "cmp" \
                 and not r.get("passes") \
                 and (cur is None or r["value"] >= cur["value"]):
             # the seed is the best default-config TPU number: an experiment
@@ -565,7 +568,9 @@ def main() -> int:
     # budget so the line lands while the driver is still listening — never
     # AFTER it (a floor above the budget reproduces the round-2 rc=124)
     signal.signal(signal.SIGALRM, bail)
-    signal.alarm(max(min(int(budget) - 10, int(budget) - 2), 1))
+    # 10s of pre-budget slack normally; tiny budgets keep most of their
+    # window and still fire before the external deadline
+    signal.alarm(max(1, int(budget) - (10 if budget > 20 else 1)))
 
     force = os.environ.get("CYLON_BENCH_BACKEND")  # test/ops override
     if force not in (None, "cpu", "tpu"):

@@ -184,6 +184,23 @@ def time64(unit: str = "us") -> DataType:
     return DataType(Type.TIME64, unit=unit)
 
 
+def join_key_mismatch(a_is_string: bool, b_is_string: bool, same_type: bool,
+                      either_empty: bool):
+    """Shared join-key compatibility policy (used by both the Table API
+    and the out-of-core engine so the two rungs can never drift):
+    returns "structural" (string vs non-string — buffers aren't even the
+    same rank, always fatal), "mismatch" (differing non-string types on
+    non-empty sides: concat promotion silently corrupts the packed sort
+    operands), or None (compatible; an empty side's inferred dtype is
+    vacuous because output values gather from the original typed
+    buffers)."""
+    if a_is_string != b_is_string:
+        return "structural"
+    if not a_is_string and not same_type and not either_empty:
+        return "mismatch"
+    return None
+
+
 def is_numeric(dt: DataType) -> bool:
     return Type.BOOL <= dt.type <= Type.DOUBLE
 

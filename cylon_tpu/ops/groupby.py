@@ -69,6 +69,11 @@ def partial_ops(op: AggOp) -> Tuple[AggOp, ...]:
         AggOp.MEAN: (AggOp.SUM, AggOp.COUNT),
         AggOp.VAR: (AggOp.SUM, AggOp.COUNT, AggOp.SUMSQ),
         AggOp.STDDEV: (AggOp.SUM, AggOp.COUNT, AggOp.SUMSQ),
+        # the internal partial states are their own partials, so a caller
+        # holding partial columns (the out-of-core cross-pass combine) can
+        # push them through the distributed two-phase group-by unchanged
+        AggOp.SUMSQ: (AggOp.SUMSQ,),
+        AggOp.COUNTSUM: (AggOp.COUNTSUM,),
     }[op]
 
 

@@ -411,7 +411,8 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
 
         facc = precision.float_acc()
         fdt = dtypes.float_ if precision.narrow() else dtypes.double
-        if op in (AggOp.SUM, AggOp.MIN, AggOp.MAX, AggOp.COUNT):
+        if op in (AggOp.SUM, AggOp.MIN, AggOp.MAX, AggOp.COUNT,
+                  AggOp.SUMSQ, AggOp.COUNTSUM):
             out_cols.append(pcol(op))
         elif op == AggOp.MEAN:
             s, c = pcol(AggOp.SUM), pcol(AggOp.COUNT)

@@ -976,8 +976,12 @@ def _check_join_keys(left: Table, right: Table, cfg: JoinConfig) -> JoinConfig:
         # corrupt join output).  The reference's typed comparators reject
         # this at kernel dispatch (arrow_comparator.hpp); we reject at
         # the API.
-        string_alike = dtypes.is_string_like(lt) and dtypes.is_string_like(rt)
-        if not string_alike and lt != rt:
+        kind = dtypes.join_key_mismatch(
+            dtypes.is_string_like(lt), dtypes.is_string_like(rt), lt == rt,
+            # row_count is only consulted on the rare mismatch path — the
+            # host sync it costs never lands on a well-typed join
+            lt != rt and (left.row_count == 0 or right.row_count == 0))
+        if kind is not None:
             raise CylonError(
                 Code.Invalid,
                 f"join key type mismatch: {left.names[li]}:{lt} vs "

@@ -13,6 +13,8 @@ import pytest
 
 from cylon_tpu import Table
 
+pytestmark = pytest.mark.slow
+
 CAP = 512  # shared static capacity -> one compiled program per op shape
 SEEDS = list(range(12))
 

@@ -2,6 +2,9 @@
 examples double as smoke tests, cpp/src/examples/*.cpp)."""
 import numpy as np
 import pyarrow as pa
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def test_join_csv_example():

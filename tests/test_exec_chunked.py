@@ -100,6 +100,7 @@ def test_chunked_narrow_key_domain(rng):
     assert stats["passes"] <= 3
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("passes", [1, 5])
 def test_chunked_distributed_matches_pandas(ctx8, rng, passes):
     """Multi-chip rung: each key-range pass sharded over the 8-device mesh

@@ -169,6 +169,7 @@ def prefix_segsum(narrow_mode):
     segments.set_segsum(None)
 
 
+@pytest.mark.slow
 def test_prefix_segmented_reductions_match_scatter(ctx4, rng, prefix_segsum):
     """CYLON_TPU_SEGSUM=prefix: the segmented-scan reductions must agree
     with pandas (and hence with the default scatter path) on every float

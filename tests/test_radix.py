@@ -104,6 +104,7 @@ def test_cumsum_matmul_matches_xla():
                                   np.cumsum(np.asarray(m)).astype(np.int32))
 
 
+@pytest.mark.slow
 def test_join_level_radix_agreement(monkeypatch):
     """End-to-end: join + groupby pipeline results agree across sort modes.
     jit caches key on shapes only (env is read at trace time), so caches

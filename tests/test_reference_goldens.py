@@ -78,6 +78,7 @@ def test_set_op_golden(op, world, request):
 
 
 @needs_ref
+@pytest.mark.slow
 def test_user_usage_counts(request):
     """Global totals of python/test/test_dist_rl.py:77-100 (per-rank counts
     1424/1648/2704/1552 join, 62/53/53/72 union+intersect, 0 subtract)."""

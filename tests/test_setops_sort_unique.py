@@ -20,7 +20,7 @@ def _rowset(df):
     return set(map(tuple, df.to_numpy().tolist()))
 
 
-@pytest.mark.parametrize("world", [1, 2, 4])
+@pytest.mark.parametrize("world", [1, 2, pytest.param(4, marks=pytest.mark.slow)])
 def test_set_ops(request, rng, world):
     ctx = request.getfixturevalue("local_ctx" if world == 1 else f"ctx{world}")
     pa_, pb_ = _set_frames(rng)
@@ -59,7 +59,7 @@ def test_local_sort_strings(local_ctx):
     assert t.to_pydict()["s"] == sorted(vals)
 
 
-@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("world", [2, pytest.param(4, marks=pytest.mark.slow), pytest.param(8, marks=pytest.mark.slow)])
 def test_distributed_sort(request, rng, world):
     ctx = request.getfixturevalue(f"ctx{world}")
     df = pd.DataFrame({"a": rng.integers(0, 1000, 500), "b": rng.random(500)})
@@ -70,7 +70,7 @@ def test_distributed_sort(request, rng, world):
     assert sorted(got["a"]) == sorted(df["a"])
 
 
-@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("world", [2, pytest.param(4, marks=pytest.mark.slow), pytest.param(8, marks=pytest.mark.slow)])
 def test_distributed_sort_string_lead(request, rng, world):
     """Global sort on a STRING lead column — beyond the reference (its
     RangePartitionKernel is numeric only): the range partitioner bins on

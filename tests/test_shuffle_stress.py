@@ -34,6 +34,7 @@ def test_total_skew_one_hot_key(world_fixture, rng, request):
     assert per_shard.sum() == n and per_shard.max() == n
 
 
+@pytest.mark.slow
 def test_skewed_join_groupby(ctx4, rng):
     """90% of rows share one key — join fan-out + groupby must agree with
     pandas (this is the distribution the bucketed plan over-padded on)."""

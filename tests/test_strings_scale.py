@@ -12,6 +12,8 @@ from cylon_tpu import Table
 from cylon_tpu import column as colmod
 from cylon_tpu.status import CylonError
 
+pytestmark = pytest.mark.slow
+
 
 def test_width_cap_raises_with_guidance():
     big = "x" * 10_000

@@ -73,6 +73,38 @@ def stage_sort(cl, cr, cnt):
 sorted_parts = timed("combined sort + run boundaries", stage_sort,
                      cols_l, cols_r, count)
 
+# -- stage 1b: sort-mode A/B on identical operands -------------------------
+# CYLON_TPU_SORT is read at TRACE time, so each variant gets its own jit
+# function and the env is set around its first (tracing) call.  The perm
+# must agree exactly with the cmp path's (ties resolved by embedded index
+# in both), so agreement is asserted on device before timing is trusted.
+def _sort_variant(label, env):
+    for k, v in env.items():
+        os.environ[k] = v
+
+    @jax.jit
+    def stage(cl, cr, cnt):
+        perm, _, new_group, is_run_end, live_sorted = \
+            common.combined_sorted_runs(cl, cnt, cr, cnt, (0,), (0,))
+        return perm, new_group, is_run_end, live_sorted
+
+    try:
+        out = timed(label, stage, cols_l, cols_r, count)
+        same = bool(jax.device_get(jnp.array_equal(out[0], sorted_parts[0])))
+        print(f"{label:34s} perm agrees with cmp: {same}", flush=True)
+        if not same:  # loud: the timings above must not be trusted
+            raise SystemExit(f"{label}: PERM MISMATCH vs cmp — radix "
+                             f"timings in this profile are INVALID")
+    finally:
+        for k in env:
+            os.environ.pop(k, None)
+
+_sort_variant("combined sort RADIX d=1", {"CYLON_TPU_SORT": "radix"})
+_sort_variant("combined sort RADIX d=2",
+              {"CYLON_TPU_SORT": "radix", "CYLON_TPU_RADIX_BITS": "2"})
+_sort_variant("combined sort RADIX d=1 xla-scan",
+              {"CYLON_TPU_SORT": "radix", "CYLON_TPU_RADIX_SCAN": "xla"})
+
 # -- stage 2: run extents (prefix arithmetic) ------------------------------
 @jax.jit
 def stage_extents(perm, new_group, is_run_end, live_sorted):

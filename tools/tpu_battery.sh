@@ -1,9 +1,17 @@
 #!/bin/bash
 # Unattended TPU measurement battery — run when the axon tunnel is up
 # (tools/tpu_watch.sh polls and fires this automatically).
+#
+# ROUND-4 ORDERING: outages last hours and a window may be short, so the
+# steps land in VERDICT-priority order — headline number first, then the
+# stage profile that sizes the sort bottleneck (incl. the radix A/B), then
+# the radix-mode driver metric, then the FIRST-EVER 1B-row out-of-core
+# measurement, then the secondary experiments.
+#
 # Produces under $OUT (default /tmp/battery):
-#   bench_sort.json bench_hash.json bench_prefix.json bench_climb.json
-#   bench_chunked.json profile.txt smoke.json baselines_full.json
+#   bench_sort.json profile.txt bench_radix.json bench_chunked.json
+#   bench_hash.json bench_climb.json bench_prefix.json smoke.json
+#   baselines_full.json
 # Each step is independently timeout-guarded so one hang cannot eat the rest.
 set -u
 cd "$(dirname "$0")/.."
@@ -11,45 +19,52 @@ OUT=${1:-/tmp/battery}
 mkdir -p "$OUT"
 log() { echo "[battery $(date +%H:%M:%S)] $*"; }
 
-# bench.py now enforces its own internal deadline (CYLON_BENCH_BUDGET_S)
-# and emits a valid line on SIGTERM/SIGALRM, so guards are budget + slack.
-log "1/7 bench (sort algorithm, default ladder)"
-CYLON_BENCH_BUDGET_S=1800 timeout 1900 python bench.py \
+# bench.py enforces its own internal deadline (CYLON_BENCH_BUDGET_S) and
+# emits a valid line on SIGTERM/SIGALRM, so guards are budget + slack.
+log "1/9 bench (sort algorithm, default ladder) — headline driver metric"
+CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
     > "$OUT/bench_sort.json" 2> "$OUT/bench_sort.log"
 log "bench sort rc=$? $(head -c 200 "$OUT/bench_sort.json" 2>/dev/null)"
 
-log "2/7 bench (hash algorithm, one size down)"
-CYLON_BENCH_ALGO=hash CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1800 \
-    timeout 1900 python bench.py \
-    > "$OUT/bench_hash.json" 2> "$OUT/bench_hash.log"
-log "bench hash rc=$? $(head -c 200 "$OUT/bench_hash.json" 2>/dev/null)"
+log "2/9 stage profile at 32M rows/side (incl. cmp-vs-radix sort A/B)"
+timeout 2400 python tools/profile_pipeline.py 33554432 \
+    > "$OUT/profile.txt" 2> "$OUT/profile.log"
+log "profile rc=$?"
 
-log "3/7 bench (segmented-scan reductions, one size down)"
-CYLON_TPU_SEGSUM=prefix CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1800 \
-    timeout 1900 python bench.py \
-    > "$OUT/bench_prefix.json" 2> "$OUT/bench_prefix.log"
-log "bench prefix rc=$? $(head -c 200 "$OUT/bench_prefix.json" 2>/dev/null)"
+log "3/9 bench (radix sort mode, default ladder) — live A/B vs step 1"
+CYLON_TPU_SORT=radix CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
+    > "$OUT/bench_radix.json" 2> "$OUT/bench_radix.log"
+log "bench radix rc=$? $(head -c 200 "$OUT/bench_radix.json" 2>/dev/null)"
 
-log "4/7 bench climb (toward 1B rows: 2^28 then 2^27 per side)"
-CYLON_BENCH_ROWS=268435456,134217728 CYLON_BENCH_BUDGET_S=2700 \
-    timeout 2800 python bench.py \
-    > "$OUT/bench_climb.json" 2> "$OUT/bench_climb.log"
-log "bench climb rc=$? $(head -c 200 "$OUT/bench_climb.json" 2>/dev/null)"
-
-log "5/7 bench chunked (out-of-core, 2^29 rows/side = 1.07B total, 16 passes)"
+log "4/9 bench chunked (out-of-core, 2^29 rows/side = 1.07B total, 16 passes)"
 CYLON_BENCH_ROWS=536870912,268435456 CYLON_BENCH_PASSES=16 \
     CYLON_BENCH_BUDGET_S=5000 timeout 5100 python bench.py \
     > "$OUT/bench_chunked.json" 2> "$OUT/bench_chunked.log"
 log "bench chunked rc=$? $(head -c 200 "$OUT/bench_chunked.json" 2>/dev/null)"
 
-log "6/7 stage profile at 32M rows/side"
-timeout 2400 python tools/profile_pipeline.py 33554432 \
-    > "$OUT/profile.txt" 2> "$OUT/profile.log"
-log "profile rc=$?"
+log "5/9 bench (hash algorithm, one size down)"
+CYLON_BENCH_ALGO=hash CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
+    timeout 1600 python bench.py \
+    > "$OUT/bench_hash.json" 2> "$OUT/bench_hash.log"
+log "bench hash rc=$? $(head -c 200 "$OUT/bench_hash.json" 2>/dev/null)"
 
-log "7/7 kernel smoke + TPC-H full preset"
+log "6/9 bench climb (toward 1B rows single-program: 2^28 then 2^27 per side)"
+CYLON_BENCH_ROWS=268435456,134217728 CYLON_BENCH_BUDGET_S=2700 \
+    timeout 2800 python bench.py \
+    > "$OUT/bench_climb.json" 2> "$OUT/bench_climb.log"
+log "bench climb rc=$? $(head -c 200 "$OUT/bench_climb.json" 2>/dev/null)"
+
+log "7/9 bench (segmented-scan reductions, one size down)"
+CYLON_TPU_SEGSUM=prefix CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
+    timeout 1600 python bench.py \
+    > "$OUT/bench_prefix.json" 2> "$OUT/bench_prefix.log"
+log "bench prefix rc=$? $(head -c 200 "$OUT/bench_prefix.json" 2>/dev/null)"
+
+log "8/9 kernel smoke"
 timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"
 log "smoke rc=$?"
+
+log "9/9 TPC-H full preset"
 timeout 3600 python -m examples.run_baselines full \
     > "$OUT/baselines_full.json" 2> "$OUT/baselines_full.log"
 log "baselines rc=$?"
