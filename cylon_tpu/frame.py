@@ -50,7 +50,14 @@ class DataFrame:
         self._table = self._initialize_dataframe(data, columns, dtype, ctx)
         self._index = RangeIndex(0, self._table.row_count)
         if index is not None:
-            self._index = index if isinstance(index, Index) else ColumnIndex(index)
+            # constructor index= is ALWAYS row labels (pandas), even when
+            # the labels coincide with column names — only set_index
+            # prefers the column interpretation
+            from .index import as_label_index
+
+            self._table._index = as_label_index(index,
+                                                self._table.row_count)
+            self._index = self._table.index
 
     # -- construction (frame.py:63-146) ------------------------------------
     def _initialize_dataframe(self, data, columns, dtype, ctx) -> Table:
@@ -131,6 +138,40 @@ class DataFrame:
     @property
     def index(self) -> Index:
         return self._index
+
+    def set_index(self, key, drop: bool = True) -> "DataFrame":
+        """Route loc lookups through ``key`` (a column name, list of
+        names, Index, or row_count labels).  ``drop`` removes used index
+        column(s) from the data and DEFAULTS TO TRUE like pandas — this
+        facade mirrors pandas, while Table.set_index keeps the column."""
+        self._table.set_index(key)
+        self._index = self._table.index
+        if drop:
+            from .index import ColumnIndex
+
+            if isinstance(self._index, ColumnIndex):
+                keep = [n for n in self._table.names
+                        if n not in self._index.names]
+                dropped = self._table.project(keep)
+                dropped._index = self._index
+                self._table = dropped
+        return self
+
+    def reset_index(self) -> "DataFrame":
+        self._table.reset_index()
+        self._index = self._table.index
+        return self
+
+    @property
+    def loc(self) -> "_FrameIndexer":
+        """Label-based row access (working analog of the reference's
+        stubbed _libs/index.pyx loc engine)."""
+        return _FrameIndexer(self, "loc")
+
+    @property
+    def iloc(self) -> "_FrameIndexer":
+        """Position-based row access."""
+        return _FrameIndexer(self, "iloc")
 
     @property
     def shape(self):
@@ -299,10 +340,6 @@ class DataFrame:
              if self.is_distributed else self._table.unique(subset, keep))
         return DataFrame._wrap(t)
 
-    def set_index(self, key) -> "DataFrame":
-        self._index = ColumnIndex(key)
-        return self
-
     def __getattr__(self, name: str):
         # column access as attribute, pandas-style
         if name.startswith("_"):
@@ -312,3 +349,20 @@ class DataFrame:
             cols, total = table.project([name])._gathered_columns()
             return Series(name, column=cols[0], row_count=total)
         raise AttributeError(name)
+
+
+class _FrameIndexer:
+    """loc/iloc facade over the Table indexers, re-wrapping as DataFrame
+    (reference intent: _libs/index.pyx LocIndexr — stubbed there, working
+    here)."""
+
+    def __init__(self, df: DataFrame, kind: str):
+        self._df = df
+        self._kind = kind
+
+    def __getitem__(self, key) -> DataFrame:
+        t = self._df._table
+        out = t.loc[key] if self._kind == "loc" else t.iloc[key]
+        wrapped = DataFrame._wrap(out)
+        wrapped._index = out.index
+        return wrapped

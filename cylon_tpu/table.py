@@ -365,14 +365,78 @@ class Table:
         return idx if idx is not None else RangeIndex(0, self.row_count)
 
     def set_index(self, key) -> None:
-        from .index import ColumnIndex, Index
+        """Route row lookups through ``key`` (reference: table.pyx:1992-2022
+        — an Index object, a column name / list of names, or row_count
+        labels).  Unlike the reference's stubbed loc engine
+        (_libs/index.pyx get_loc: pass), the resulting index actually
+        resolves ``loc`` lookups here."""
+        from .index import process_index_by_value
 
-        self._index = key if isinstance(key, Index) else ColumnIndex(key)
+        self._index = process_index_by_value(key, self)
 
     def reset_index(self, key=None) -> None:
         from .index import RangeIndex
 
         self._index = RangeIndex(0, self.row_count)
+
+    @property
+    def loc(self) -> "_LocIndexer":
+        """Label-based row access over the active index: ``t.loc[label]``,
+        ``t.loc[[l1, l2]]``, ``t.loc[lo:hi]`` (inclusive), boolean masks,
+        and ``t.loc[rows, cols]`` column selection."""
+        return _LocIndexer(self)
+
+    @property
+    def iloc(self) -> "_ILocIndexer":
+        """Position-based row access: int (negatives ok), slice, int
+        list/array, boolean mask, and ``t.iloc[rows, cols]``."""
+        return _ILocIndexer(self)
+
+    def take_rows(self, positions) -> "Table":
+        """Gather rows by position (host or device int array) into a new
+        table — the compact/gather kernel behind loc/iloc."""
+        if self.num_shards != 1:
+            raise CylonError(Code.Invalid,
+                             "row access requires a local (1-shard) table; "
+                             "gather or repartition first")
+        import numpy as _np
+
+        idx = _np.asarray(positions, _np.int64)
+        n = idx.shape[0]
+        cap = max(8, n)
+        pad_idx = jnp.asarray(_np.concatenate(
+            [idx, _np.zeros(cap - n, _np.int64)]) if cap > n else idx,
+            jnp.int32)
+        from .ops import compact as compact_mod
+
+        mask = compact_mod.live_mask(cap, jnp.asarray(n, jnp.int32))
+        cols = tuple(c.take(pad_idx, valid_mask=mask) for c in self.columns)
+        out = Table(cols, jnp.asarray([n], jnp.int32), self.names, self.ctx)
+        from .index import (CategoricalIndex, ColumnIndex, Int64Index,
+                            RangeIndex)
+
+        idx_obj = getattr(self, "_index", None)
+        if isinstance(idx_obj, CategoricalIndex):
+            out._index = CategoricalIndex(
+                _np.asarray(idx_obj.index_values, object)[idx])
+        elif isinstance(idx_obj, ColumnIndex):
+            vals = idx_obj.index_values
+            if len(idx_obj.names) == 1:
+                out._index = ColumnIndex(idx_obj.names[0],
+                                         _np.asarray(vals)[idx])
+            else:
+                out._index = ColumnIndex(
+                    list(idx_obj.names),
+                    [_np.asarray(v)[idx] for v in vals])
+        elif idx_obj is None or isinstance(idx_obj, RangeIndex):
+            # positional labels survive selection (pandas: iloc[[5,7]]
+            # keeps labels 5,7, not a fresh 0..n-1 range)
+            labels = (_np.asarray(idx_obj.index_values) if idx_obj is not None
+                      else _np.arange(self.row_count, dtype=_np.int64))
+            out._index = Int64Index(labels[idx])
+        else:  # NumericIndex and friends: gather their labels
+            out._index = type(idx_obj)(_np.asarray(idx_obj.index_values)[idx])
+        return out
 
     def isna(self) -> "Table":
         """alias of isnull (reference: data/table.pyx:1761)."""
@@ -931,6 +995,69 @@ def _host_row_counts(t: Table) -> np.ndarray:
         return np.asarray(multihost_utils.process_allgather(
             t.row_counts, tiled=True))
     return np.asarray(jax.device_get(t.row_counts))
+
+
+class _LocIndexer:
+    """Label-based row access (the WORKING analog of the reference's
+    stubbed _libs/index.pyx LocIndexr.get_loc)."""
+
+    def __init__(self, table: Table):
+        self._t = table
+
+    def __getitem__(self, key) -> Table:
+        from .index import loc_positions
+
+        key, cols = _split_row_col_key(key, self._t.names)
+        try:
+            pos = loc_positions(self._t.index, key, self._t.row_count)
+        except KeyError as e:
+            raise CylonError(Code.KeyError, str(e))
+        out = self._t.take_rows(pos)
+        if cols is not None:
+            sub = out.project(cols)
+            sub._index = out._index  # project builds a fresh Table
+            out = sub
+        return out
+
+
+class _ILocIndexer:
+    """Position-based row access (pandas iloc semantics)."""
+
+    def __init__(self, table: Table):
+        self._t = table
+
+    def __getitem__(self, key) -> Table:
+        from .index import iloc_positions
+
+        key, cols = _split_row_col_key(key, self._t.names)
+        try:
+            pos = iloc_positions(key, self._t.row_count)
+        except IndexError as e:
+            raise CylonError(Code.IndexError, str(e))
+        out = self._t.take_rows(pos)
+        if cols is not None:
+            sub = out.project(cols)
+            sub._index = out._index  # project builds a fresh Table
+            out = sub
+        return out
+
+
+def _split_row_col_key(key, names):
+    """``indexer[rows, cols]`` support: a 2-tuple whose second element
+    selects columns.  A tuple is also how multi-index labels spell, so the
+    second element only counts as a column selection when it actually
+    names table columns (or is a positional int with non-scalar rows)."""
+    if isinstance(key, tuple) and len(key) == 2:
+        rows, cols = key
+        if isinstance(cols, str) and cols in names:
+            return rows, [cols]
+        if isinstance(cols, list) and cols and \
+                all(isinstance(c, str) and c in names for c in cols):
+            return rows, cols
+        if isinstance(cols, (int, np.integer)) and \
+                not isinstance(rows, (int, np.integer, str)):
+            return rows, [int(cols)]
+    return key, None
 
 
 def _check_schemas(a: Table, b: Table) -> None:

@@ -1,0 +1,33 @@
+"""Build hook: compile the native layer into the wheel.
+
+The reference builds libcylon via CMake and links pycylon against it
+(python/setup.py:51-55); here the native layer is dependency-free C++
+compiled by cylon_tpu/native/build.py, so the wheel build just invokes it
+and ships the .so as package data.  If no toolchain is available the
+wheel still builds — the runtime falls back to pure-Python paths
+(cylon_tpu.native.available() -> False) and can self-compile on first
+import where a compiler exists.
+"""
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        here = Path(__file__).parent
+        sys.path.insert(0, str(here))
+        try:
+            from cylon_tpu.native import build as native_build
+
+            native_build.build(verbose=True)
+        except Exception as e:  # no toolchain: ship source-only, see module doc
+            print(f"[setup] native build skipped: {e}", file=sys.stderr)
+        finally:
+            sys.path.pop(0)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

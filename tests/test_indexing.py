@@ -1,0 +1,229 @@
+"""Index machinery + loc/iloc row access.
+
+Mirrors the reference's index scenarios (python/test/test_index.py:
+set_index by labels -> CategoricalIndex, by column name(s) -> ColumnIndex,
+RangeIndex arithmetic) and goes beyond them: the reference's loc engine
+(_libs/index.pyx LocIndexr.get_loc) is an empty stub, while these
+lookups actually resolve.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import CylonError, Table
+from cylon_tpu.frame import DataFrame
+from cylon_tpu.index import (CategoricalIndex, ColumnIndex, Index,
+                             RangeIndex, range_calculator)
+
+
+@pytest.fixture
+def t(local_ctx):
+    return Table.from_pandas(pd.DataFrame({
+        "max_speed": [1, 4, 7, 10],
+        "shield": [2, 5, 8, 11],
+        "name": ["cobra", "viper", "sidewinder", "viper"]}), ctx=local_ctx)
+
+
+# -- reference test_index.py scenarios ---------------------------------------
+
+def test_range_index_values_and_len():
+    r = RangeIndex(range(0, 10, 2))
+    assert list(r.index_values) == list(range(0, 10, 2))
+    assert len(r) == 5
+    for rg in [range(0, 10), range(0, 10, 2), range(0, 11, 2), range(0, 14, 3)]:
+        assert range_calculator(RangeIndex(rg)) == sum(1 for _ in rg)
+
+
+def test_set_index_by_labels_categorical(t):
+    labels = ["a", "b", "c", "d"]
+    t.set_index(labels)
+    assert isinstance(t.index, CategoricalIndex)
+    assert list(t.index.index_values) == labels
+
+
+def test_set_index_by_column_name(t):
+    t.set_index("name")
+    assert isinstance(t.index, ColumnIndex)
+    assert list(t.index.index_values) == ["cobra", "viper", "sidewinder",
+                                          "viper"]
+
+
+def test_set_index_by_column_names_multi(t):
+    t.set_index(["max_speed", "shield"])
+    assert isinstance(t.index, ColumnIndex)
+    vals = t.index.index_values
+    assert list(vals[0]) == [1, 4, 7, 10]
+    assert list(vals[1]) == [2, 5, 8, 11]
+
+
+def test_default_index_is_range(t):
+    assert isinstance(t.index, RangeIndex)
+    assert len(t.index) == 4
+
+
+def test_reset_index(t):
+    t.set_index("name")
+    t.reset_index()
+    assert isinstance(t.index, RangeIndex)
+
+
+def test_set_index_bad_key(t):
+    with pytest.raises(KeyError):
+        t.set_index("nope")
+
+
+# -- loc (label) -------------------------------------------------------------
+
+def test_loc_single_label_all_matches(t):
+    t.set_index("name")
+    out = t.loc["viper"]
+    assert out.to_pydict()["max_speed"] == [4, 10]
+    # the selection carries its index rows along
+    assert list(out.index.index_values) == ["viper", "viper"]
+
+
+def test_loc_label_list_in_order(t):
+    t.set_index("name")
+    out = t.loc[["sidewinder", "cobra"]]
+    assert out.to_pydict()["max_speed"] == [7, 1]
+
+
+def test_loc_label_slice_inclusive(t):
+    t.set_index("name")
+    out = t.loc["cobra":"sidewinder"]
+    assert out.to_pydict()["max_speed"] == [1, 4, 7]
+
+
+def test_loc_missing_label_raises(t):
+    t.set_index("name")
+    with pytest.raises(CylonError, match="KeyError"):
+        t.loc["python"]
+
+
+def test_loc_with_column_selection(t):
+    t.set_index("name")
+    out = t.loc["viper", "shield"]
+    assert out.column_names == ["shield"]
+    assert out.to_pydict()["shield"] == [5, 11]
+
+
+def test_loc_boolean_mask(t):
+    t.set_index("name")
+    out = t.loc[np.array([True, False, False, True])]
+    assert out.to_pydict()["max_speed"] == [1, 10]
+
+
+def test_loc_on_range_index_is_label_arithmetic(t):
+    out = t.loc[1:2]   # inclusive on labels == positions here
+    assert out.to_pydict()["max_speed"] == [4, 7]
+    with pytest.raises(CylonError, match="KeyError"):
+        t.loc[99]
+
+
+def test_loc_categorical_index(t):
+    t.set_index(["w", "x", "y", "z"])
+    assert t.loc["x"].to_pydict()["max_speed"] == [4]
+    assert t.loc["x":"z"].to_pydict()["max_speed"] == [4, 7, 10]
+
+
+def test_loc_multi_column_index_tuple_label(t):
+    t.set_index(["max_speed", "shield"])
+    out = t.loc[(4, 5)]
+    assert out.to_pydict()["name"] == ["viper"]
+    with pytest.raises(CylonError, match="KeyError"):
+        t.loc[(4, 99)]
+
+
+# -- iloc (position) ---------------------------------------------------------
+
+def test_iloc_int_and_negative(t):
+    assert t.iloc[2].to_pydict()["name"] == ["sidewinder"]
+    assert t.iloc[-1].to_pydict()["name"] == ["viper"]
+
+
+def test_iloc_slice_and_list(t):
+    assert t.iloc[1:3].to_pydict()["max_speed"] == [4, 7]
+    assert t.iloc[[3, 0]].to_pydict()["max_speed"] == [10, 1]
+
+
+def test_iloc_bool_mask_and_cols(t):
+    out = t.iloc[np.array([False, True, True, False]), 0]
+    assert out.column_names == ["max_speed"]
+    assert out.to_pydict()["max_speed"] == [4, 7]
+
+
+def test_iloc_out_of_bounds(t):
+    with pytest.raises(CylonError, match="IndexError"):
+        t.iloc[9]
+
+
+def test_bool_mask_wrong_length_raises(t):
+    with pytest.raises(CylonError, match="mask length"):
+        t.iloc[np.array([True, False, False, True, True])]
+    with pytest.raises(CylonError, match="mask length"):
+        t.loc[np.array([True])]
+
+
+def test_iloc_preserves_positional_labels(t):
+    from cylon_tpu.index import Int64Index
+
+    sub = t.iloc[[1, 3]]
+    assert isinstance(sub.index, Int64Index)
+    assert list(sub.index.index_values) == [1, 3]
+    # chained loc by ORIGINAL position labels, as pandas does
+    assert sub.loc[3].to_pydict()["name"] == ["viper"]
+
+
+def test_loc_with_cols_keeps_index(t):
+    t.set_index("name")
+    sub = t.loc[["viper", "cobra"], "shield"]
+    assert list(sub.index.index_values) == ["viper", "viper", "cobra"]
+    assert sub.loc["cobra"].to_pydict()["shield"] == [2]
+
+
+# -- DataFrame facade --------------------------------------------------------
+
+def test_frame_loc_iloc_roundtrip(local_ctx):
+    df = DataFrame(pd.DataFrame({"k": ["a", "b", "c"], "v": [1, 2, 3]}),
+                   ctx=local_ctx)
+    df.set_index("k")
+    assert df.loc["b"].to_pandas()["v"].tolist() == [2]
+    assert df.iloc[0:2].to_pandas()["v"].tolist() == [1, 2]
+    assert isinstance(df.index, ColumnIndex)
+
+
+def test_frame_set_index_drop(local_ctx):
+    df = DataFrame(pd.DataFrame({"k": ["a", "b"], "v": [1, 2]}),
+                   ctx=local_ctx)
+    df.set_index("k", drop=True)
+    assert df.columns == ["v"]
+    assert df.loc["a"].to_pandas()["v"].tolist() == [1]
+
+
+def test_frame_constructor_index_labels(local_ctx):
+    df = DataFrame({"v": [10, 20, 30]}, index=["x", "y", "z"], ctx=local_ctx)
+    assert isinstance(df.index, CategoricalIndex)
+    assert df.loc["y"].to_pandas()["v"].tolist() == [20]
+
+
+def test_frame_constructor_labels_colliding_with_column_names(local_ctx):
+    """Constructor index= is ALWAYS row labels, even when the labels
+    coincide with column names (pandas semantics)."""
+    df = DataFrame({"x": [1, 2], "y": [3, 4]}, index=["x", "y"],
+                   ctx=local_ctx)
+    assert isinstance(df.index, CategoricalIndex)
+    assert df.loc["x"].to_pandas()["x"].tolist() == [1]
+
+
+def test_frame_set_index_drops_by_default(local_ctx):
+    df = DataFrame(pd.DataFrame({"k": ["a", "b"], "v": [1, 2]}),
+                   ctx=local_ctx)
+    df.set_index("k")
+    assert df.columns == ["v"]   # pandas drop=True default
+    assert df.loc["b"].to_pandas()["v"].tolist() == [2]
+
+
+def test_multishard_row_access_raises(ctx4):
+    t = Table.from_pandas(pd.DataFrame({"a": np.arange(50)}), ctx=ctx4)
+    with pytest.raises(CylonError, match="1-shard"):
+        t.iloc[3]
