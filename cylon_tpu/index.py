@@ -141,9 +141,16 @@ def process_index_by_value(key, table) -> Index:
     process_index_by_value): an Index passes through; a column name (or
     list of names) becomes a ColumnIndex with that column's host values;
     an array-like of row_count labels becomes a CategoricalIndex."""
+    names = list(table.names)
+    if isinstance(key, ColumnIndex) and key.index_values is None:
+        # a bare ColumnIndex("name") (the pre-round-4 API shape) carries
+        # no values; materialize them so loc/take_rows actually work
+        if all(n in names for n in key.names):
+            key = key.names[0] if len(key.names) == 1 else list(key.names)
+        else:
+            raise KeyError(f"ColumnIndex names {key.names} not all in table")
     if isinstance(key, Index):
         return key
-    names = list(table.names)
     if isinstance(key, str) and key in names:
         return ColumnIndex(key, table.project([key]).to_numpy()[key])
     if isinstance(key, (list, tuple, np.ndarray)):
@@ -176,10 +183,11 @@ def as_label_index(key, row_count: int) -> Index:
 
 def _match_positions(values, label) -> np.ndarray:
     values = np.asarray(values)
-    if values.dtype == object:
-        pos = np.flatnonzero(np.asarray([v == label for v in values]))
-    else:
-        pos = np.flatnonzero(values == label)
+    # object arrays compare elementwise in C too — no Python-level scan
+    eq = values == label
+    if not isinstance(eq, np.ndarray):  # exotic __eq__ returned a scalar
+        eq = np.asarray([v == label for v in values])
+    pos = np.flatnonzero(eq)
     if pos.size == 0:
         raise KeyError(f"label {label!r} not in index")
     return pos

@@ -380,17 +380,17 @@ class Table:
         self._index = RangeIndex(0, self.row_count)
 
     @property
-    def loc(self) -> "_LocIndexer":
+    def loc(self) -> "_TableIndexer":
         """Label-based row access over the active index: ``t.loc[label]``,
         ``t.loc[[l1, l2]]``, ``t.loc[lo:hi]`` (inclusive), boolean masks,
         and ``t.loc[rows, cols]`` column selection."""
-        return _LocIndexer(self)
+        return _TableIndexer(self, "loc")
 
     @property
-    def iloc(self) -> "_ILocIndexer":
+    def iloc(self) -> "_TableIndexer":
         """Position-based row access: int (negatives ok), slice, int
         list/array, boolean mask, and ``t.iloc[rows, cols]``."""
-        return _ILocIndexer(self)
+        return _TableIndexer(self, "iloc")
 
     def take_rows(self, positions) -> "Table":
         """Gather rows by position (host or device int array) into a new
@@ -997,41 +997,27 @@ def _host_row_counts(t: Table) -> np.ndarray:
     return np.asarray(jax.device_get(t.row_counts))
 
 
-class _LocIndexer:
-    """Label-based row access (the WORKING analog of the reference's
-    stubbed _libs/index.pyx LocIndexr.get_loc)."""
+class _TableIndexer:
+    """loc/iloc row access, one implementation parameterized by kind
+    (loc: the WORKING analog of the reference's stubbed _libs/index.pyx
+    LocIndexr.get_loc; iloc: pandas positional semantics)."""
 
-    def __init__(self, table: Table):
+    def __init__(self, table: Table, kind: str):
         self._t = table
+        self._kind = kind
 
     def __getitem__(self, key) -> Table:
-        from .index import loc_positions
+        from .index import iloc_positions, loc_positions
 
-        key, cols = _split_row_col_key(key, self._t.names)
+        key, cols = _split_row_col_key(key, self._t.names,
+                                       split_always=self._kind == "iloc")
         try:
-            pos = loc_positions(self._t.index, key, self._t.row_count)
+            if self._kind == "loc":
+                pos = loc_positions(self._t.index, key, self._t.row_count)
+            else:
+                pos = iloc_positions(key, self._t.row_count)
         except KeyError as e:
             raise CylonError(Code.KeyError, str(e))
-        out = self._t.take_rows(pos)
-        if cols is not None:
-            sub = out.project(cols)
-            sub._index = out._index  # project builds a fresh Table
-            out = sub
-        return out
-
-
-class _ILocIndexer:
-    """Position-based row access (pandas iloc semantics)."""
-
-    def __init__(self, table: Table):
-        self._t = table
-
-    def __getitem__(self, key) -> Table:
-        from .index import iloc_positions
-
-        key, cols = _split_row_col_key(key, self._t.names)
-        try:
-            pos = iloc_positions(key, self._t.row_count)
         except IndexError as e:
             raise CylonError(Code.IndexError, str(e))
         out = self._t.take_rows(pos)
@@ -1042,13 +1028,22 @@ class _ILocIndexer:
         return out
 
 
-def _split_row_col_key(key, names):
+def _split_row_col_key(key, names, split_always: bool = False):
     """``indexer[rows, cols]`` support: a 2-tuple whose second element
-    selects columns.  A tuple is also how multi-index labels spell, so the
-    second element only counts as a column selection when it actually
-    names table columns (or is a positional int with non-scalar rows)."""
+    selects columns.  For iloc (``split_always``) a 2-tuple is ALWAYS
+    (rows, cols) — iloc has no tuple labels, and pandas' ``iloc[0, 1]``
+    means cell access, never rows (0, 1).  For loc a tuple is also how
+    multi-index labels spell, so the second element only counts as a
+    column selection when it actually names table columns (or is a
+    positional int with non-scalar rows)."""
     if isinstance(key, tuple) and len(key) == 2:
         rows, cols = key
+        if split_always:
+            if isinstance(cols, (int, np.integer, str)):
+                return rows, [cols if isinstance(cols, str) else int(cols)]
+            if isinstance(cols, slice):
+                return rows, list(names[cols])
+            return rows, cols  # lists pass through; project() validates
         if isinstance(cols, str) and cols in names:
             return rows, [cols]
         if isinstance(cols, list) and cols and \

@@ -89,6 +89,115 @@ def run(sf: float = 0.01, world: int | None = None, seed: int = 0,
                 world=ctx.GetWorldSize(), nations=len(res), sf=sf)
 
 
+def run_ooc(sf: float = 1.0, passes: int | None = None, seed: int = 0,
+            check: bool = False) -> dict:
+    """Q5 at scales past one chip's HBM: the same five-way join + group-by
+    chained through the out-of-core engine (exec.chunked_join), with
+    column pruning between stages so host intermediates stay narrow.
+    The final dimension join + group-by fuse into one
+    chunked_join_groupby_tables call (partial/final combine — group key
+    n_name does not pin the partition key).  BASELINE config 4 pipeline
+    at arbitrary SF on a single chip."""
+    import pandas as pd
+
+    from cylon_tpu.exec import chunked_join, chunked_join_groupby_tables
+
+    if passes is None:
+        # lineitem is ~6M rows/SF; keep a pass comfortably inside the 84
+        # B/row budget (PERF.md): ~2^24 rows/side per pass
+        passes = max(1, int(np.ceil(sf * 6_000_000 / (1 << 24))))
+    rng = np.random.default_rng(seed)
+    raw_c = tpch_data.customer(sf, rng)
+    raw_o = tpch_data.orders(sf, rng)
+    raw_l = tpch_data.lineitem(sf, rng, q5_keys=True,
+                               orders_rows=len(raw_o["o_orderkey"]))
+    raw_s = tpch_data.supplier(sf, rng)
+    raw_n = tpch_data.nation()
+    raw_r = tpch_data.region()
+    rows = (len(raw_l["l_orderkey"]) + len(raw_o["o_orderkey"])
+            + len(raw_c["c_custkey"]))
+
+    t0 = time.perf_counter()
+    # host-side date filter (the reference pushes the filter below the
+    # join too)
+    sel = ((raw_o["o_orderdate"] >= tpch_data.Q5_LO)
+           & (raw_o["o_orderdate"] < tpch_data.Q5_HI))
+    orders_f = {"o_orderkey": raw_o["o_orderkey"][sel],
+                "o_custkey": raw_o["o_custkey"][sel]}
+    cust = {"c_custkey": raw_c["c_custkey"],
+            "c_nationkey": raw_c["c_nationkey"]}
+    r1, _ = chunked_join(cust, orders_f, left_on="c_custkey",
+                         right_on="o_custkey", how="inner", passes=passes)
+    r1 = {"c_nationkey": r1["c_nationkey"], "o_orderkey": r1["o_orderkey"]}
+
+    line = {"l_orderkey": raw_l["l_orderkey"],
+            "l_suppkey": raw_l["l_suppkey"],
+            "l_extendedprice": raw_l["l_extendedprice"],
+            "l_discount": raw_l["l_discount"]}
+    r2, _ = chunked_join(r1, line, left_on="o_orderkey",
+                         right_on="l_orderkey", how="inner", passes=passes)
+    r2 = {k: r2[k] for k in ("c_nationkey", "l_suppkey",
+                             "l_extendedprice", "l_discount")}
+
+    supp = {"s_suppkey": raw_s["s_suppkey"],
+            "s_nationkey": raw_s["s_nationkey"]}
+    r3, _ = chunked_join(r2, supp, left_on="l_suppkey",
+                         right_on="s_suppkey", how="inner", passes=passes)
+    keep = np.asarray(r3["c_nationkey"]) == np.asarray(r3["s_nationkey"])
+    revenue = (np.asarray(r3["l_extendedprice"])[keep]
+               * (1.0 - np.asarray(r3["l_discount"])[keep]))
+    fact = {"c_nationkey": np.asarray(r3["c_nationkey"])[keep],
+            "revenue": revenue}
+
+    # ASIA nations only (region pre-joined host-side: 25x5 rows)
+    asia_key = tpch_data.REGIONS.index("ASIA")
+    nsel = raw_n["n_regionkey"] == asia_key
+    nation_asia = {"n_nationkey": raw_n["n_nationkey"][nsel],
+                   "n_name": raw_n["n_name"][nsel]}
+    res, stats = chunked_join_groupby_tables(
+        fact, nation_asia, left_on="c_nationkey", right_on="n_nationkey",
+        how="inner", group_by="n_name", agg={"revenue": ["sum"]},
+        passes=min(passes, 4))
+    out = pd.DataFrame({"n_name": res["n_name"],
+                        "sum_revenue": np.asarray(res["sum_revenue"],
+                                                  np.float64)})
+    out = out.sort_values("sum_revenue", ascending=False)
+    dt = time.perf_counter() - t0
+
+    if check:
+        exp = _pandas_golden(raw_c, raw_o, raw_l, raw_s, raw_n, raw_r,
+                             asia_key)
+        assert len(out) == len(exp), (len(out), len(exp))
+        got = dict(zip(out["n_name"], out["sum_revenue"]))
+        for name, rev in zip(exp["n_name"], exp["revenue"]):
+            np.testing.assert_allclose(got[name], rev, rtol=1e-4)
+    return emit("tpch_q5_ooc", rows=rows, seconds=dt, rows_per_sec=rows / dt,
+                passes=passes, nations=len(out), sf=sf)
+
+
+def _pandas_golden(raw_c, raw_o, raw_l, raw_s, raw_n, raw_r, asia_key):
+    import pandas as pd
+
+    c = pd.DataFrame(raw_c)
+    odf = pd.DataFrame(raw_o)
+    l = pd.DataFrame(raw_l)
+    s = pd.DataFrame(raw_s)
+    n = pd.DataFrame(raw_n)
+    r = pd.DataFrame(raw_r)
+    odf = odf[(odf.o_orderdate >= tpch_data.Q5_LO)
+              & (odf.o_orderdate < tpch_data.Q5_HI)]
+    j = (c.merge(odf, left_on="c_custkey", right_on="o_custkey")
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey"))
+    j = j[j.c_nationkey == j.s_nationkey]
+    j = (j.merge(n, left_on="c_nationkey", right_on="n_nationkey")
+         .merge(r, left_on="n_regionkey", right_on="r_regionkey"))
+    j = j[j.r_regionkey == asia_key]
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    return (j.groupby("n_name").revenue.sum()
+            .sort_values(ascending=False).reset_index())
+
+
 if __name__ == "__main__":
     import sys
 

@@ -3,11 +3,15 @@
 The reference builds libcylon via CMake and links pycylon against it
 (python/setup.py:51-55); here the native layer is dependency-free C++
 compiled by cylon_tpu/native/build.py, so the wheel build just invokes it
-and ships the .so as package data.  If no toolchain is available the
-wheel still builds — the runtime falls back to pure-Python paths
+and ships the .so as package data.  build.py is loaded DIRECTLY from its
+file (not via the package): importing cylon_tpu would import jax, which
+is absent in pip's default isolated build env, and the hook must still
+compile there.  If no toolchain is available the wheel still builds —
+the runtime falls back to pure-Python paths
 (cylon_tpu.native.available() -> False) and can self-compile on first
 import where a compiler exists.
 """
+import importlib.util
 import sys
 from pathlib import Path
 
@@ -17,16 +21,16 @@ from setuptools.command.build_py import build_py
 
 class BuildWithNative(build_py):
     def run(self):
-        here = Path(__file__).parent
-        sys.path.insert(0, str(here))
+        build_file = (Path(__file__).parent / "cylon_tpu" / "native"
+                      / "build.py")
         try:
-            from cylon_tpu.native import build as native_build
-
-            native_build.build(verbose=True)
+            spec = importlib.util.spec_from_file_location(
+                "_cylon_native_build", build_file)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.build(verbose=True)
         except Exception as e:  # no toolchain: ship source-only, see module doc
             print(f"[setup] native build skipped: {e}", file=sys.stderr)
-        finally:
-            sys.path.pop(0)
         super().run()
 
 

@@ -28,6 +28,15 @@ def test_tpch_q5_example():
     assert rec["nations"] >= 1
 
 
+def test_tpch_q5_out_of_core_matches_golden():
+    """The full-preset Q5 path: five-way join chained through the
+    out-of-core engine, checked against the pandas golden."""
+    from examples import tpch_q5
+
+    rec = tpch_q5.run_ooc(sf=0.01, passes=3, check=True)
+    assert rec["nations"] >= 1 and rec["passes"] == 3
+
+
 def test_shuffle_example():
     from examples import shuffle_bench
 

@@ -146,6 +146,23 @@ def test_iloc_slice_and_list(t):
     assert t.iloc[[3, 0]].to_pydict()["max_speed"] == [10, 1]
 
 
+def test_iloc_scalar_scalar_is_cell_access(t):
+    """iloc[0, 1] means (row 0, col 1) — never rows (0, 1)."""
+    out = t.iloc[0, 1]
+    assert out.column_names == ["shield"]
+    assert out.to_pydict() == {"shield": [2]}
+    out2 = t.iloc[1, "name"]
+    assert out2.to_pydict() == {"name": ["viper"]}
+
+
+def test_set_index_bare_column_index_materializes(t):
+    """The pre-round-4 API shape set_index(ColumnIndex('name')) carried
+    no values; it must now resolve loc like set_index('name')."""
+    t.set_index(ColumnIndex("name"))
+    assert t.loc["viper"].to_pydict()["max_speed"] == [4, 10]
+    assert t.iloc[0].to_pydict()["name"] == ["cobra"]
+
+
 def test_iloc_bool_mask_and_cols(t):
     out = t.iloc[np.array([False, True, True, False]), 0]
     assert out.column_names == ["max_speed"]
