@@ -1,0 +1,181 @@
+/* JVM consumer of the cylon_tpu native binding surface via Panama FFM.
+ *
+ * Plays the role of the reference's Java binding
+ * (java/src/main/java/org/cylondata/cylon/Table.java:275-293 +
+ * java/src/main/native/src/Table.cpp): a JVM host that builds a table
+ * through the raw-buffer builder, enumerates the registry, and reads
+ * columns back zero-copy — all through the C ABI in
+ * cylon_tpu/native/include/cylon_tpu_c.h.  Where the reference needs a
+ * hand-written JNI shim per function, Panama (java.lang.foreign,
+ * JDK 22+) binds the same fifteen symbols directly — no native glue.
+ *
+ * Build + run (tests/test_native.py::test_jvm_consumer_builds_and_reads
+ * does this when a JDK is present; run.sh wraps it):
+ *   javac CylonTpuSmoke.java
+ *   java --enable-native-access=ALL-UNNAMED \
+ *        -Dcylon.native=<path/to/libcylon_tpu.so> CylonTpuSmoke
+ * Prints PASS lines and exits 0 on success.
+ */
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.nio.file.Path;
+
+public final class CylonTpuSmoke {
+    static final Linker L = Linker.nativeLinker();
+    static SymbolLookup lib;
+
+    static MethodHandle h(String name, FunctionDescriptor d) {
+        return L.downcallHandle(lib.find(name).orElseThrow(
+            () -> new RuntimeException("missing symbol " + name)), d);
+    }
+
+    static int checks = 0;
+
+    static void check(boolean cond, String msg) {
+        if (!cond) {
+            System.err.println("FAIL: " + msg);
+            System.exit(1);
+        }
+        System.out.println("PASS: " + msg);
+        checks++;
+    }
+
+    public static void main(String[] args) throws Throwable {
+        String so = System.getProperty("cylon.native",
+            "../../cylon_tpu/native/libcylon_tpu.so");
+        try (Arena arena = Arena.ofConfined()) {
+            lib = SymbolLookup.libraryLookup(Path.of(so), arena);
+
+            var I = ValueLayout.JAVA_INT;
+            var J = ValueLayout.JAVA_LONG;
+            var P = ValueLayout.ADDRESS;
+            MethodHandle beginH = h("ct_builder_begin",
+                FunctionDescriptor.of(I, P));
+            MethodHandle addH = h("ct_builder_add_column",
+                FunctionDescriptor.of(I, P, P, I, I, J, P, P, P));
+            MethodHandle finishH = h("ct_builder_finish",
+                FunctionDescriptor.of(I, P));
+            MethodHandle containsH = h("ct_registry_contains",
+                FunctionDescriptor.of(I, P));
+            MethodHandle rowsH = h("ct_table_rows",
+                FunctionDescriptor.of(J, P));
+            MethodHandle ncolsH = h("ct_table_ncols",
+                FunctionDescriptor.of(I, P));
+            MethodHandle colNameH = h("ct_table_col_name",
+                FunctionDescriptor.of(I, P, I, P, I));
+            MethodHandle colDataH = h("ct_table_col_data",
+                FunctionDescriptor.of(P, P, I));
+            MethodHandle colValidityH = h("ct_table_col_validity",
+                FunctionDescriptor.of(P, P, I));
+            MethodHandle colLengthsH = h("ct_table_col_lengths",
+                FunctionDescriptor.of(P, P, I));
+            MethodHandle colInfoH = h("ct_table_col_info",
+                FunctionDescriptor.of(I, P, I, P, P, P, P, P));
+            MethodHandle removeH = h("ct_registry_remove",
+                FunctionDescriptor.of(I, P));
+            MethodHandle sizeH = h("ct_registry_size",
+                FunctionDescriptor.of(J));
+            MethodHandle idsH = h("ct_registry_ids",
+                FunctionDescriptor.of(J, P, J));
+            MethodHandle clearH = h("ct_registry_clear",
+                FunctionDescriptor.ofVoid());
+
+            // dtype codes from cylon_tpu.dtypes.Type (opaque to the
+            // registry; must only agree with the reading side)
+            final int DT_INT64 = 8, DT_DOUBLE = 11, DT_STRING = 12;
+
+            MemorySegment id = arena.allocateFrom("jvm_orders");
+            MemorySegment ids = arena.allocateFrom(ValueLayout.JAVA_LONG,
+                10L, 20L, 30L, 40L);
+            MemorySegment vals = arena.allocateFrom(ValueLayout.JAVA_DOUBLE,
+                1.5, 2.5, 3.5, 4.5);
+            MemorySegment valid = arena.allocateFrom(ValueLayout.JAVA_BYTE,
+                (byte) 1, (byte) 1, (byte) 0, (byte) 1);
+
+            check((int) beginH.invoke(id) == 0, "builder begin");
+            check((int) beginH.invoke(id) == -1, "double begin rejected");
+            check((int) addH.invoke(id, arena.allocateFrom("id"), DT_INT64,
+                8, 4L, ids, MemorySegment.NULL, MemorySegment.NULL) == 0,
+                "add int64 column");
+            check((int) addH.invoke(id, arena.allocateFrom("v"), DT_DOUBLE,
+                8, 4L, vals, valid, MemorySegment.NULL) == 0,
+                "add double column with validity");
+            // strings ride a padded byte matrix (width 4) + per-row byte
+            // lengths — the same layout cylon_tpu Columns use on device
+            MemorySegment tags = arena.allocateFrom(ValueLayout.JAVA_BYTE,
+                (byte) 'a', (byte) 'b', (byte) 0, (byte) 0,
+                (byte) 'c', (byte) 0, (byte) 0, (byte) 0,
+                (byte) 'l', (byte) 'o', (byte) 'n', (byte) 'g',
+                (byte) 'x', (byte) 0, (byte) 0, (byte) 0);
+            MemorySegment lens = arena.allocateFrom(ValueLayout.JAVA_INT,
+                2, 1, 4, 1);
+            check((int) addH.invoke(id, arena.allocateFrom("tag"), DT_STRING,
+                4, 4L, tags, MemorySegment.NULL, lens) == 0,
+                "add string column with lengths");
+            check((int) addH.invoke(id, arena.allocateFrom("bad"), DT_INT64,
+                8, 5L, ids, MemorySegment.NULL, MemorySegment.NULL) == -2,
+                "row-count mismatch rejected");
+            check((int) containsH.invoke(id) == 0,
+                "not visible before finish");
+            check((int) finishH.invoke(id) == 0, "builder finish");
+            check((int) containsH.invoke(id) == 1, "registered after finish");
+
+            check((long) rowsH.invoke(id) == 4L, "row count");
+            check((int) ncolsH.invoke(id) == 3, "column count");
+            check((long) sizeH.invoke() == 1L, "registry size");
+
+            long idsLen = (long) idsH.invoke(MemorySegment.NULL, 0L);
+            MemorySegment idsBuf = arena.allocate(idsLen + 1);
+            idsH.invoke(idsBuf, idsLen + 1);
+            check(idsBuf.getString(0).contains("jvm_orders"),
+                "registry ids enumeration");
+
+            MemorySegment nameBuf = arena.allocate(32);
+            int n = (int) colNameH.invoke(id, 1, nameBuf, 32);
+            check(n == 1 && nameBuf.getString(0).equals("v"),
+                "column name round-trip");
+
+            MemorySegment dtOut = arena.allocate(ValueLayout.JAVA_INT);
+            MemorySegment wOut = arena.allocate(ValueLayout.JAVA_INT);
+            MemorySegment rOut = arena.allocate(ValueLayout.JAVA_LONG);
+            MemorySegment hvOut = arena.allocate(ValueLayout.JAVA_INT);
+            MemorySegment hlOut = arena.allocate(ValueLayout.JAVA_INT);
+            check((int) colInfoH.invoke(id, 2, dtOut, wOut, rOut, hvOut,
+                hlOut) == 0
+                && dtOut.get(ValueLayout.JAVA_INT, 0) == DT_STRING
+                && wOut.get(ValueLayout.JAVA_INT, 0) == 4
+                && rOut.get(ValueLayout.JAVA_LONG, 0) == 4L
+                && hlOut.get(ValueLayout.JAVA_INT, 0) == 1,
+                "column info (dtype/width/rows/lengths flags)");
+
+            MemorySegment slens = ((MemorySegment) colLengthsH.invoke(id, 2))
+                .reinterpret(4 * 4);
+            check(slens.getAtIndex(ValueLayout.JAVA_INT, 2) == 4,
+                "string lengths read");
+
+            MemorySegment data = ((MemorySegment) colDataH.invoke(id, 1))
+                .reinterpret(4 * 8);
+            check(data.getAtIndex(ValueLayout.JAVA_DOUBLE, 1) == 2.5,
+                "zero-copy double read");
+            MemorySegment vmask = ((MemorySegment) colValidityH.invoke(id, 1))
+                .reinterpret(4);
+            check(vmask.get(ValueLayout.JAVA_BYTE, 2) == 0,
+                "validity read (null at row 2)");
+            MemorySegment idata = ((MemorySegment) colDataH.invoke(id, 0))
+                .reinterpret(4 * 8);
+            check(idata.getAtIndex(ValueLayout.JAVA_LONG, 3) == 40L,
+                "zero-copy int64 read");
+
+            check((int) removeH.invoke(id) == 0, "registry remove");
+            check((int) containsH.invoke(id) == 0, "gone after remove");
+            clearH.invoke();
+            check((long) sizeH.invoke() == 0L, "registry clear");
+        }
+        System.out.println("ALL " + checks + " CHECKS PASSED");
+    }
+}

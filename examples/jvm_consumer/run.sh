@@ -1,0 +1,12 @@
+#!/bin/bash
+# Compile + run the Panama FFM smoke consumer against the built
+# libcylon_tpu.so.  Requires a JDK 22+ (java.lang.foreign is final there).
+# Usage: examples/jvm_consumer/run.sh [path/to/libcylon_tpu.so]
+set -eu
+cd "$(dirname "$0")"
+PY=$(command -v python3 || command -v python)
+SO=${1:-$(PYTHONPATH="$PWD/../..${PYTHONPATH:+:$PYTHONPATH}" "$PY" -c \
+    "from cylon_tpu.native import build; print(build.build())")}
+javac CylonTpuSmoke.java
+exec java --enable-native-access=ALL-UNNAMED \
+     -Dcylon.native="$SO" CylonTpuSmoke

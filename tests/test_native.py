@@ -272,3 +272,40 @@ def test_c_consumer_builds_and_reads(tmp_path):
                               timeout=60)
     assert run_proc.returncode == 0, run_proc.stdout + run_proc.stderr
     assert "ALL PASS" in run_proc.stdout
+
+
+def test_jvm_consumer_builds_and_reads(tmp_path):
+    """A JVM host drives the registry + builder through the C ABI via
+    Panama FFM (examples/jvm_consumer) — the letter-complete counterpart
+    of the reference's Java binding (Table.java:275-293 + JNI natives),
+    with java.lang.foreign replacing the hand-written JNI shim.  Skips
+    where no JDK 22+ exists (this CI image has none; the consumer is the
+    shipping artifact)."""
+    import shutil
+    import subprocess
+
+    javac = shutil.which("javac")
+    java = shutil.which("java")
+    if not javac or not java:
+        pytest.skip("no JDK on this image")
+    ver = subprocess.run([java, "-version"], capture_output=True, text=True)
+    import re
+
+    m = re.search(r'version "(\d+)', ver.stderr + ver.stdout)
+    if not m or int(m.group(1)) < 22:
+        pytest.skip("JDK 22+ required for final java.lang.foreign")
+
+    from cylon_tpu.native import build as native_build
+
+    lib = native_build.build()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(root, "examples", "jvm_consumer", "CylonTpuSmoke.java")
+    compile_proc = subprocess.run([javac, "-d", str(tmp_path), src],
+                                  capture_output=True, text=True)
+    assert compile_proc.returncode == 0, compile_proc.stderr
+    run_proc = subprocess.run(
+        [java, "--enable-native-access=ALL-UNNAMED",
+         f"-Dcylon.native={lib}", "-cp", str(tmp_path), "CylonTpuSmoke"],
+        capture_output=True, text=True, timeout=120)
+    assert run_proc.returncode == 0, run_proc.stdout + run_proc.stderr
+    assert "CHECKS PASSED" in run_proc.stdout
