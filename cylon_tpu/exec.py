@@ -384,6 +384,60 @@ class _SideBuilder:
                 string_width=self.widths.get(n, colmod.DEFAULT_STRING_WIDTH)))
         return tuple(cols), jnp.asarray(n_sel, jnp.int32)
 
+    def empty_chunk(self, only: Optional[Sequence[str]] = None):
+        """Zero-count chunk with the SAME shapes as every real chunk —
+        compiles the pass program without re-paying a host compression
+        pass over the largest chunk."""
+        cols = []
+        for n in (only if only is not None else self.names):
+            a = np.asarray(self.arrs[n])[:0]
+            cols.append(colmod.from_numpy(
+                a, capacity=self.cap,
+                string_width=self.widths.get(n, colmod.DEFAULT_STRING_WIDTH)))
+        return tuple(cols), jnp.asarray(0, jnp.int32)
+
+
+def _null_mask(a: np.ndarray):
+    """Host null mask matching Column.from_numpy's validity inference
+    (NaN floats, NaT datetimes, None/NaN objects), or None."""
+    if a.dtype.kind == "f":
+        return np.isnan(a)
+    if a.dtype.kind in "Mm":
+        return np.isnat(a)
+    if a.dtype.kind == "O":
+        try:
+            import pandas as pd
+
+            return np.asarray(pd.isna(a), bool)
+        except ImportError:
+            return np.asarray([x is None for x in a])
+    return None
+
+
+def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0):
+    """Shared streaming loop: compile on a zero-count chunk (same shapes,
+    no duplicate host pass over the largest chunk), then double-buffer —
+    pass p dispatches async while pass p+1's host compression + upload
+    overlap it (CYLON_TPU_PREFETCH=0 reverts to strictly serial)."""
+    warm = empty_chunk()
+    jax.block_until_ready(prog(*warm))
+    del warm
+    t_plan = time.perf_counter() - t0
+    prefetch = os.environ.get("CYLON_TPU_PREFETCH", "1") != "0"
+    t_run0 = time.perf_counter()
+    frames, total = [], 0
+    nxt = chunk(0) if prefetch else None
+    for p in range(n_passes):
+        cur = nxt if prefetch else chunk(p)
+        fut = prog(*cur)
+        nxt = chunk(p + 1) if prefetch and p + 1 < n_passes else None
+        frame, n = fetch(fut)
+        total += n
+        frames.append(frame)
+        del cur, fut
+    del nxt
+    return t_plan, t_run0, frames, total
+
 
 def _concat_host(frames: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
     if not frames:
@@ -745,6 +799,158 @@ def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
              "shard_cap": shard_cap,
              "groups" if gb_names is not None else "rows": total,
              "plan_seconds": t_plan, "run_seconds": t_run,
+             "total_seconds": t_plan + t_run}
+    return result, stats
+
+
+# ---------------------------------------------------------------------------
+# standalone out-of-core operators (no join): group-by and sort
+# ---------------------------------------------------------------------------
+
+def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
+                    mode: str = "auto", ctx=None):
+    """Out-of-core group-by over one host frame: the key domain is
+    partitioned on the GROUP columns themselves, so every pass's
+    group-by is final (a group never spans passes) and the results just
+    concatenate — the single-frame analog of the distributed two-phase
+    group-by's shuffle-on-keys (reference groupby/groupby.cpp:23-73).
+
+    Returns (dict of host columns, stats)."""
+    t0 = time.perf_counter()
+    names, arrs = _as_host_frame(data)
+    by_names = _resolve_keys(names, by, None, "group")
+    aggs_req = _normalize_agg(agg, names)
+    key_arrs = [np.asarray(arrs[n]) for n in by_names]
+    empty = [np.zeros(0, a.dtype) for a in key_arrs]
+    pid, _, n_passes, mode_used = _plan_pass_ids(key_arrs, empty, passes, mode)
+    counts = np.bincount(pid, minlength=n_passes)
+    cap = pow2ceil(int(max(8, counts.max(initial=0))))
+    by_idx = tuple(names.index(n) for n in by_names)
+    aggs_dev = tuple((names.index(n), op) for n, op in aggs_req)
+    out_names = list(by_names) + [f"{op.name.lower()}_{n}"
+                                  for n, op in aggs_req]
+
+    world = 1 if ctx is None else ctx.GetWorldSize()
+    frames: List[Dict[str, np.ndarray]] = []
+    total = 0
+    if world > 1:
+        from .table import Table
+
+        shard_cap = pow2ceil(int(max(8, -(-int(counts.max(initial=0))
+                                         // world))))
+        pass_agg: Dict[str, list] = {}
+        for n, op in aggs_req:
+            pass_agg.setdefault(n, []).append(op)
+        t_plan = time.perf_counter() - t0
+        t_run0 = time.perf_counter()
+        for p in range(n_passes):
+            sel = pid == p
+            t = Table.from_numpy(names, [np.asarray(arrs[n])[sel]
+                                         for n in names], ctx=ctx,
+                                 capacity=shard_cap * world)
+            g = t.groupby(by_names, pass_agg, ddof=ddof)
+            frames.append(g.to_numpy())
+            total += g.row_count
+    else:
+        build = _SideBuilder(names, arrs, pid, cap)
+
+        @jax.jit
+        def prog(cols, cnt):
+            return groupby_mod.hash_groupby(cols, cnt, by_idx, aggs_dev,
+                                            ddof)
+
+        def fetch(out):
+            gcols, g = out
+            n = int(g)
+            return {name: colmod.to_numpy(c, n)
+                    for name, c in zip(out_names, gcols)}, n
+
+        t_plan, t_run0, frames, total = _run_passes(
+            prog, build.empty_chunk, build.chunk, n_passes, fetch, t0)
+    result = _concat_host(frames)
+    t_run = time.perf_counter() - t_run0
+    stats = {"passes": n_passes, "mode": mode_used, "world": world,
+             "groups": total, "plan_seconds": t_plan,
+             "run_seconds": t_run, "total_seconds": t_plan + t_run}
+    return result, stats
+
+
+def chunked_sort(data, by, *, ascending=True, nulls_first: bool = True,
+                 passes: int = 4, ctx=None):
+    """Out-of-core GLOBAL sort of one host frame: range-partition on the
+    first sort column's order-preserving prefix (equal keys co-locate,
+    ranges are contiguous in key order), sort each pass on device, and
+    emit passes in key order — the streamed analog of DistributedSort's
+    sample + range shuffle + local sort (reference table.cpp:313-356).
+    Null first-key rows are routed to whichever pass is emitted first
+    (``nulls_first``) or last, since the planning prefix cannot express
+    the device kernels' null ordering.
+
+    Returns (dict of host columns in global sort order, stats)."""
+    t0 = time.perf_counter()
+    names, arrs = _as_host_frame(data)
+    by_names = _resolve_keys(names, by, None, "sort")
+    if isinstance(ascending, bool):
+        ascending = [ascending] * len(by_names)
+    if len(ascending) != len(by_names):
+        raise CylonError(Code.Invalid,
+                         f"ascending length {len(ascending)} != "
+                         f"{len(by_names)} sort columns")
+    key0 = np.asarray(arrs[by_names[0]])
+    empty = np.zeros(0, key0.dtype)
+    pid, _, n_passes, _ = _plan_pass_ids([key0], [empty], passes, "range")
+    emit_order = (list(range(n_passes)) if ascending[0]
+                  else list(range(n_passes - 1, -1, -1)))
+    nulls = _null_mask(key0)
+    if nulls is not None and nulls.any():
+        target = emit_order[0] if nulls_first else emit_order[-1]
+        pid = np.where(nulls, target, pid)
+    counts = np.bincount(pid, minlength=n_passes)
+    cap = pow2ceil(int(max(8, counts.max(initial=0))))
+    by_idx = tuple(names.index(n) for n in by_names)
+    asc = tuple(bool(a) for a in ascending)
+
+    world = 1 if ctx is None else ctx.GetWorldSize()
+    frames: List[Dict[str, np.ndarray]] = []
+    total = 0
+    if world > 1:
+        from .config import SortOptions
+        from .table import Table
+
+        t_plan = time.perf_counter() - t0
+        t_run0 = time.perf_counter()
+        for p in emit_order:
+            sel = pid == p
+            t = Table.from_numpy(names, [np.asarray(arrs[n])[sel]
+                                         for n in names], ctx=ctx,
+                                 capacity=cap)
+            s = t.distributed_sort(
+                by_names, options=SortOptions(nulls_first=nulls_first),
+                ascending=list(asc))
+            frames.append(s.to_numpy())
+            total += s.row_count
+    else:
+        from .ops import sort as sort_mod
+
+        build = _SideBuilder(names, arrs, pid, cap)
+
+        @jax.jit
+        def prog(cols, cnt):
+            return sort_mod.sort_rows(cols, cnt, by_idx, asc, nulls_first)
+
+        def fetch(out):
+            scols, cnt = out
+            n = int(cnt)
+            return {name: colmod.to_numpy(c, n)
+                    for name, c in zip(names, scols)}, n
+
+        t_plan, t_run0, frames, total = _run_passes(
+            prog, build.empty_chunk, lambda p: build.chunk(emit_order[p]),
+            n_passes, fetch, t0)
+    result = _concat_host(frames)
+    t_run = time.perf_counter() - t_run0
+    stats = {"passes": n_passes, "mode": "range", "world": world,
+             "rows": total, "plan_seconds": t_plan, "run_seconds": t_run,
              "total_seconds": t_plan + t_run}
     return result, stats
 

@@ -79,7 +79,13 @@ def build_operands(cols: Sequence[Column], row_count, capacity: int,
     for i, col in enumerate(cols):
         col_ops = column_operands(col, nulls_first=nulls_first)
         if ascending is not None and not ascending[i]:
-            col_ops = [_invert_operand(o) for o in col_ops]
+            # flip the DATA order only: null placement is governed by
+            # nulls_first alone, independent of per-column direction
+            # (pandas na_position semantics — inverting the validity
+            # operand would silently send nulls to the other end on
+            # descending columns)
+            col_ops = [col_ops[0]] + [_invert_operand(o)
+                                      for o in col_ops[1:]]
         ops.extend(col_ops)
     return ops
 

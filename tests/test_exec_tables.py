@@ -268,6 +268,121 @@ def test_chunked_deep_common_prefix_strings_fan_out(rng):
     assert stats["rows"] == len(ref)
 
 
+def test_chunked_groupby_standalone(rng):
+    """Out-of-core group-by with no join: partitioned on the group key,
+    so every pass is final — incl. NUNIQUE, which the cross-pass combine
+    cannot do."""
+    from cylon_tpu.exec import chunked_groupby
+
+    n = 6000
+    df = pd.DataFrame({"g": rng.integers(0, 200, n).astype(np.int64),
+                       "v": rng.random(n).round(3),
+                       "w": rng.integers(0, 10, n).astype(np.int64)})
+    got, stats = chunked_groupby(df, "g",
+                                 {"v": ["sum", "mean"], "w": ["nunique"]},
+                                 passes=5)
+    ref = (df.groupby("g", as_index=False)
+           .agg(sum_v=("v", "sum"), mean_v=("v", "mean"),
+                nunique_w=("w", "nunique")))
+    assert stats["groups"] == len(ref)
+    order = np.argsort(got["g"], kind="stable")
+    ref = ref.sort_values("g").reset_index(drop=True)
+    np.testing.assert_array_equal(got["g"][order], ref["g"])
+    np.testing.assert_allclose(np.asarray(got["sum_v"][order], np.float64),
+                               ref["sum_v"], rtol=1e-9)
+    np.testing.assert_array_equal(
+        np.asarray(got["nunique_w"][order], np.int64), ref["nunique_w"])
+
+
+def test_chunked_groupby_string_key(rng):
+    from cylon_tpu.exec import chunked_groupby
+
+    n = 3000
+    df = pd.DataFrame({
+        "g": np.asarray([f"grp-{rng.integers(0, 40):02d}"
+                         for _ in range(n)], dtype=object),
+        "v": rng.random(n).round(3)})
+    got, stats = chunked_groupby(df, "g", {"v": ["sum", "count"]}, passes=4)
+    ref = (df.groupby("g", as_index=False)
+           .agg(sum_v=("v", "sum"), count_v=("v", "count")))
+    assert stats["groups"] == len(ref)
+    g_df = pd.DataFrame({"g": got["g"],
+                         "sum_v": np.asarray(got["sum_v"], np.float64),
+                         "count_v": np.asarray(got["count_v"], np.int64)})
+    pd.testing.assert_frame_equal(
+        g_df.sort_values("g").reset_index(drop=True).round(6),
+        ref.sort_values("g").reset_index(drop=True).round(6),
+        check_dtype=False)
+
+
+def test_chunked_sort_global_order(rng):
+    from cylon_tpu.exec import chunked_sort
+
+    n = 8000
+    df = pd.DataFrame({"k": rng.integers(-500, 500, n).astype(np.int64),
+                       "v": rng.random(n).round(3)})
+    got, stats = chunked_sort(df, "k", passes=5)
+    assert stats["rows"] == n
+    ks = np.asarray(got["k"], np.int64)
+    assert (np.diff(ks) >= 0).all()
+    ref = df.sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(ks, ref["k"])
+    # multiset of (k, v) pairs preserved
+    assert sorted(zip(ks.tolist(), np.asarray(got["v"], float).round(4))) \
+        == sorted(zip(ref["k"], ref["v"].round(4)))
+
+
+def test_chunked_sort_descending_and_nans(rng):
+    from cylon_tpu.exec import chunked_sort
+
+    n = 2000
+    k = rng.standard_normal(n)
+    k[::37] = np.nan
+    df = pd.DataFrame({"k": k, "v": np.arange(n)})
+    got, stats = chunked_sort(df, "k", ascending=False, nulls_first=True,
+                              passes=4)
+    ks = got["k"]
+    n_nan = int(np.isnan(k).sum())
+    head = np.asarray([v is None or (isinstance(v, float) and np.isnan(v))
+                       for v in ks[:n_nan]])
+    assert head.all()          # nulls first
+    body = np.asarray(ks[n_nan:], np.float64)
+    assert (np.diff(body) <= 0).all()  # descending after the nulls
+    assert stats["rows"] == n
+
+
+def test_chunked_sort_datetime_nat_routing(rng):
+    """NaT keys must obey nulls_first like NaN/None (regression: the
+    null gate once missed datetime64, leaving NaT at INT64_MIN's pass)."""
+    from cylon_tpu.exec import chunked_sort
+
+    base = np.datetime64("2020-01-01", "us")
+    k = base + (rng.integers(0, 1000, 500) * np.timedelta64(1, "D")).astype(
+        "timedelta64[us]")
+    k = k.astype("datetime64[us]")
+    k[::41] = np.datetime64("NaT")
+    df = {"k": k, "v": np.arange(500)}
+    got, stats = chunked_sort(df, "k", nulls_first=False, passes=4)
+    n_nat = int(np.isnat(k).sum())
+    tail = got["k"][len(k) - n_nat:]
+    assert all(v is None or (isinstance(v, np.datetime64) and np.isnat(v))
+               for v in tail)
+    assert stats["rows"] == len(k)
+
+
+def test_local_sort_descending_nulls_first(local_ctx, rng):
+    """Kernel-level regression: nulls_first must hold under DESCENDING
+    sort columns too (before round 4 the validity operand was inverted
+    along with the data, silently sending nulls last)."""
+    from cylon_tpu import Table
+
+    df = pd.DataFrame({"k": [3.0, np.nan, 1.0, 2.0, np.nan]})
+    t = Table.from_pandas(df, ctx=local_ctx)
+    got = t.sort("k", ascending=False, nulls_first=True).to_pydict()["k"]
+    assert got[0] is None and got[1] is None
+    assert got[2:] == [3.0, 2.0, 1.0]
+
+
 def test_chunked_join_key_dtype_mismatch():
     from cylon_tpu.status import CylonError
 
