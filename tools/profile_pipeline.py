@@ -95,6 +95,12 @@ def _sort_variant(label, env):
         if not same:  # loud: the timings above must not be trusted
             raise SystemExit(f"{label}: PERM MISMATCH vs cmp — radix "
                              f"timings in this profile are INVALID")
+    except SystemExit:
+        raise
+    except Exception as e:  # one variant's compile failure on this
+        # backend must not eat the others' measurements
+        print(f"{label:34s} FAILED: {type(e).__name__}: {str(e)[:200]}",
+              flush=True)
     finally:
         for k in env:
             os.environ.pop(k, None)
