@@ -875,6 +875,29 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
     return result, stats
 
 
+def chunked_unique(data, columns=None, *, passes: int = 4,
+                   mode: str = "auto", ctx=None):
+    """Out-of-core distinct rows over the given columns (default: all):
+    a group-by with no aggregates — the key-domain partition makes every
+    pass's distinct set globally disjoint (streamed analog of
+    DistributedUnique's shuffle-then-local-unique, table.cpp:1031-1047).
+
+    Returns (dict of host columns, stats with "rows")."""
+    if columns is None:
+        # names only — never materialize columns here; chunked_groupby
+        # does the one full host conversion itself
+        if isinstance(data, dict):
+            columns = list(data)
+        elif hasattr(data, "names"):            # cylon_tpu Table
+            columns = list(data.names)
+        else:                                   # pandas DataFrame
+            columns = [str(c) for c in data.columns]
+    result, stats = chunked_groupby(data, columns, {}, passes=passes,
+                                    mode=mode, ctx=ctx)
+    stats["rows"] = stats.pop("groups")
+    return result, stats
+
+
 def chunked_sort(data, by, *, ascending=True, nulls_first: bool = True,
                  passes: int = 4, ctx=None):
     """Out-of-core GLOBAL sort of one host frame: range-partition on the

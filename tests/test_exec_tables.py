@@ -315,6 +315,24 @@ def test_chunked_groupby_string_key(rng):
         check_dtype=False)
 
 
+def test_chunked_unique(rng):
+    from cylon_tpu.exec import chunked_unique
+
+    n = 4000
+    df = pd.DataFrame({"a": rng.integers(0, 60, n).astype(np.int64),
+                       "b": np.asarray([f"s{rng.integers(0, 4)}"
+                                        for _ in range(n)], dtype=object)})
+    got, stats = chunked_unique(df, passes=5)
+    ref = df.drop_duplicates()
+    assert stats["rows"] == len(ref)
+    got_pairs = sorted(zip(np.asarray(got["a"], np.int64).tolist(),
+                           got["b"].tolist()))
+    assert got_pairs == sorted(map(tuple, ref.values.tolist()))
+    # single-column distinct
+    got1, st1 = chunked_unique(df, "a", passes=3)
+    assert st1["rows"] == df["a"].nunique()
+
+
 def test_chunked_sort_global_order(rng):
     from cylon_tpu.exec import chunked_sort
 
