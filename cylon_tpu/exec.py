@@ -60,7 +60,10 @@ def _as_host_frame(obj) -> Tuple[List[str], Dict[str, np.ndarray]]:
     """Normalize a pandas DataFrame / dict-of-arrays / Table to
     (ordered names, dict of host numpy columns)."""
     if isinstance(obj, dict):
-        return list(obj), {str(k): np.asarray(v) for k, v in obj.items()}
+        # stringify KEYS AND NAMES together — a names list of raw int
+        # keys against a str-keyed dict would crash every lookup
+        return ([str(k) for k in obj],
+                {str(k): np.asarray(v) for k, v in obj.items()})
     if hasattr(obj, "columns") and hasattr(obj, "to_numpy") \
             and hasattr(obj, "names"):          # cylon_tpu Table
         return list(obj.names), obj.to_numpy()
@@ -887,7 +890,7 @@ def chunked_unique(data, columns=None, *, passes: int = 4,
         # names only — never materialize columns here; chunked_groupby
         # does the one full host conversion itself
         if isinstance(data, dict):
-            columns = list(data)
+            columns = [str(k) for k in data]    # mirror _as_host_frame
         elif hasattr(data, "names"):            # cylon_tpu Table
             columns = list(data.names)
         else:                                   # pandas DataFrame

@@ -1,0 +1,35 @@
+"""The bench artifact contract the driver depends on: ONE valid JSON
+line on stdout, exit 0, under any tunnel state (indestructibility
+contract, bench.py module docstring).  A syntax error or emit-path
+regression in bench.py would otherwise cost a round its artifact."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.slow
+def test_bench_emits_one_valid_artifact_line():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the real tunnel
+    env.update({"JAX_PLATFORMS": "cpu",
+                "CYLON_BENCH_BACKEND": "cpu",
+                # budget too small for a live CPU measurement: the line
+                # must still appear (cached seed or SIGALRM best-so-far)
+                "CYLON_BENCH_BUDGET_S": "45"})
+    proc = subprocess.run([sys.executable, str(REPO / "bench.py")],
+                          capture_output=True, text=True, timeout=300,
+                          env=env, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    art = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "source"):
+        assert key in art, art
+    assert art["value"] > 0
+    assert "rows/sec" in art["unit"]
