@@ -64,7 +64,6 @@ def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
     """
     cap_l = cols_l[0].data.shape[0]
     cap_r = cols_r[0].data.shape[0]
-    n = cap_l + cap_r
     perm, _, new_group, is_run_end, live_sorted = common.combined_sorted_runs(
         cols_l, count_l, cols_r, count_r, left_on, right_on)
     is_right = perm >= cap_l
@@ -79,29 +78,49 @@ def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
             (~is_right) & live_sorted, new_group, is_run_end)
         fields.append((left_in_run == 0).astype(jnp.int32))
 
-    # one scatter maps per-sorted-position results back to original rows
-    back = jnp.zeros((n, len(fields)), jnp.int32).at[perm].set(
-        jnp.stack(fields, axis=1))
+    # map per-sorted-position results back to original rows: one fused
+    # key-sort on TPU, one scatter per field elsewhere (compact.permute_mode)
+    back = compact.inverse_permute(perm, *fields)
 
     live_l = jnp.arange(cap_l, dtype=jnp.int32) < count_l
     live_r = jnp.arange(cap_r, dtype=jnp.int32) < count_r
-    lo = back[:cap_l, 0]
-    matches = jnp.where(live_l, back[:cap_l, 1], 0)
+    lo = back[0][:cap_l]
+    matches = jnp.where(live_l, back[1][:cap_l], 0)
     if join_type in (JoinType.RIGHT, JoinType.FULL_OUTER):
-        unmatched_r = live_r & (back[cap_l:, 2] == 1)
+        unmatched_r = live_r & (back[2][cap_l:] == 1)
     else:
         unmatched_r = jnp.zeros((cap_r,), bool)
 
-    # gid-ordered right permutation: compact the combined sort's right-side
-    # entries (live rows first by key then original index, padding last —
-    # exactly the order ``lo`` indexes into)
-    idx_r, _ = compact.compact_indices(is_right)
-    perm_r = jnp.take(perm, idx_r[:cap_r]) - cap_l
-
-    # left row ids in key order, for key_grouped output
-    idx_l, _ = compact.compact_indices(~is_right)
-    left_key_order = jnp.take(perm, idx_l[:cap_l])
+    # gid-ordered right permutation AND left key order from ONE stable
+    # partition of the combined sort's entries: exactly cap_r of them are
+    # right-side (perm is a full permutation), so the front cap_r slots
+    # are the right rows in key order (the order ``lo`` indexes into) and
+    # the tail cap_l slots are the left rows in key order (key_grouped
+    # output) — half the compaction cost, which in sort mode is a full
+    # combined-length sort per call
+    part, _ = compact.partition_indices(is_right)
+    perm_r = jnp.take(perm, part[:cap_r]) - cap_l
+    left_key_order = jnp.take(perm, part[cap_r:])
     return lo, matches, perm_r, live_l, unmatched_r, left_key_order
+
+
+def _slot_to_row_merge(csum: jax.Array, out_capacity: int) -> jax.Array:
+    """``li[k] = #{i : csum[i] <= k}`` for k in [0, out_capacity) — i.e.
+    ``searchsorted(csum, k, side='right')`` with csum monotone — via one
+    merged u32 sort plus one packed compaction (both bandwidth-bound on
+    TPU, unlike the scatter this replaces).
+
+    Packing: word = value << 1 | tag (tag 1 = slot query).  A csum entry
+    v sorts before slot k exactly when v <= k, and slots keep their
+    ascending order, so slot k's merged position p satisfies
+    p = #{v <= k} + k.  Slot positions in ascending k order are the
+    tag-set positions in merged order — one mask compaction."""
+    cap_l = csum.shape[0]
+    vals = jnp.clip(csum, 0, out_capacity).astype(jnp.uint32) << 1
+    slots = (jnp.arange(out_capacity, dtype=jnp.uint32) << 1) | 1
+    merged = jax.lax.sort(jnp.concatenate([vals, slots]), is_stable=False)
+    p, _ = compact.compact_indices((merged & 1) == 1)
+    return p[:out_capacity] - jnp.arange(out_capacity, dtype=jnp.int32)
 
 
 def _emission(matches, live_l, join_type: JoinType):
@@ -184,17 +203,24 @@ def join_gather(cols_l: Tuple[Column, ...], count_l,
     emit, csum, total = _emission(matches, live_l, join_type)
 
     k = jnp.arange(out_capacity, dtype=jnp.int32)
-    # slot -> left row via scatter + cummax forward fill: each emitting row
-    # drops its index at its first output slot (bases are distinct and
-    # ascending), cummax fills the run — one scan instead of the
-    # searchsorted merge-sort over out_capacity + cap_l rows
     cap_l = emit.shape[0]
-    iota_l = jnp.arange(cap_l, dtype=jnp.int32)
     base_l = csum - emit
-    marker = jnp.full((out_capacity,), -1, jnp.int32)
-    marker = marker.at[jnp.where(emit > 0, base_l, out_capacity)].max(
-        iota_l, mode="drop")
-    li = jax.lax.cummax(marker)
+    if compact.permute_mode() == "sort":
+        # slot -> left row is searchsorted(csum, k, 'right') — csum is
+        # monotone, so slot k's emitter is the count of rows with
+        # csum <= k.  Realized as a sort-merge (sorts beat scatters on
+        # TPU): tag-bit-packed csum values and slot ids share ONE u32
+        # sort; slot k's merged position p gives li = p - k.
+        li = _slot_to_row_merge(csum, out_capacity)
+    else:
+        # scatter + cummax forward fill: each emitting row drops its index
+        # at its first output slot (bases are distinct and ascending),
+        # cummax fills the run — one scan, one scatter
+        iota_l = jnp.arange(cap_l, dtype=jnp.int32)
+        marker = jnp.full((out_capacity,), -1, jnp.int32)
+        marker = marker.at[jnp.where(emit > 0, base_l, out_capacity)].max(
+            iota_l, mode="drop")
+        li = jax.lax.cummax(marker)
     li = jnp.clip(li, 0, cap_l - 1)
     base = jnp.take(base_l, li)
     within = k - base

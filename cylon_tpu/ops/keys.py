@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column
-from . import radix
+from . import compact, radix
 
 
 def pack_string_words(data: jax.Array) -> List[jax.Array]:
@@ -190,7 +190,7 @@ def lexsort_indices(operands: Sequence[jax.Array], capacity: int) -> Tuple[jax.A
     TPU)."""
     enc = [_ordered_unsigned(o) for o in operands]
     total_bits = sum(w for _, w in enc)
-    idx_bits = max(1, (capacity - 1).bit_length()) if capacity > 1 else 1
+    idx_bits = compact.index_bits(capacity)
     if total_bits + idx_bits <= 64:
         # assemble the logical (total+idx)-bit value MSB-first across
         # (hi, lo) u32 words with static double-word shifts

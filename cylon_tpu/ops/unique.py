@@ -41,8 +41,10 @@ def unique(cols: Tuple[Column, ...], count, key_idx: Tuple[int, ...],
         rep_pos = jnp.concatenate([new_group[1:], jnp.ones((1,), bool)])
     leader = rep_pos & live_sorted  # padding runs sort last -> excluded
 
-    keep_mask = jnp.zeros((cap,), jnp.bool_).at[
-        jnp.where(leader, perm, cap)].set(True, mode="drop")
+    # leader flags travel back to original row order along the (full)
+    # sort permutation — fused key-sort on TPU, scatter elsewhere
+    keep_mask = compact.inverse_permute(
+        perm, leader.astype(jnp.int32))[0] == 1
 
     perm_keep, m = compact.compact_indices(keep_mask)
     out = tuple(c.take(perm_keep, valid_mask=compact.live_mask(cap, m)) for c in cols)

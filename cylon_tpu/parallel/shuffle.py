@@ -63,7 +63,7 @@ def _perm_by_target(targets: jax.Array, world: int) -> jax.Array:
     cap = targets.shape[0]
     targets = jnp.where((targets < 0) | (targets > world), world, targets)
     iota = jnp.arange(cap, dtype=jnp.int32)
-    if world + 1 > 32:
+    if world + 1 > 32 or compact_mod.permute_mode() == "sort":
         _, perm = jax.lax.sort((targets, iota), num_keys=1, is_stable=True)
         return perm
     dest = jnp.zeros((cap,), jnp.int32)

@@ -1,0 +1,165 @@
+"""Scatter-realized vs sort-realized permutation kernels must agree.
+
+compact.permute_mode selects how compactions / partitions / inverse
+permutations / the join expansion's slot->row map are materialized:
+"scatter" (cumsum destinations + permuting scatter — the XLA:CPU
+optimum) or "sort" (packed single-word / key sorts — the TPU optimum;
+round-4 hardware profile: a 64M-word ``lax.sort`` runs ~4x faster than a
+same-size scatter).  Both must produce identical results on every
+consumer (reference behavior being preserved: join.cpp:179-235 output
+building, table.cpp:966-1029 unique filter, arrow_kernels.hpp:60-96
+splitters).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cylon_tpu import column as colmod
+from cylon_tpu.config import JoinType
+from cylon_tpu.ops import compact, join as join_mod, unique as unique_mod
+
+
+MODES = ("scatter", "sort")
+
+
+def _per_mode(monkeypatch, fn):
+    out = {}
+    for mode in MODES:
+        monkeypatch.setenv("CYLON_TPU_PERMUTE", mode)
+        jax.clear_caches()
+        out[mode] = fn()
+    monkeypatch.delenv("CYLON_TPU_PERMUTE", raising=False)
+    jax.clear_caches()
+    return out[MODES[0]], out[MODES[1]]
+
+
+@pytest.mark.parametrize("cap", [1, 7, 256, 1 << 12])
+def test_compact_partition_agree(monkeypatch, cap):
+    rng = np.random.default_rng(cap)
+    mask = jnp.asarray(rng.integers(0, 2, cap).astype(bool))
+
+    def run():
+        idx, n = compact.compact_indices(mask)
+        perm, nt = compact.partition_indices(mask)
+        return (np.asarray(idx), int(n), np.asarray(perm), int(nt))
+
+    a, b = _per_mode(monkeypatch, run)
+    assert a[1] == b[1] and a[3] == b[3]
+    n = a[1]
+    # compact contract: first n entries identical; tail is caller-masked
+    np.testing.assert_array_equal(a[0][:n], b[0][:n])
+    # partition contract: the FULL permutation is pinned (stable partition)
+    np.testing.assert_array_equal(a[2], b[2])
+    # sort-mode tails must still be in-bounds filler
+    assert (b[0] >= 0).all() and (b[0] < cap).all()
+
+
+def test_inverse_permute_agree(monkeypatch):
+    rng = np.random.default_rng(42)
+    n = 1 << 11
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+    f1 = jnp.asarray(rng.integers(-1000, 1000, n).astype(np.int32))
+    f2 = jnp.asarray(rng.integers(0, 5, n).astype(np.int32))
+
+    def run():
+        a, b = compact.inverse_permute(perm, f1, f2)
+        return np.asarray(a), np.asarray(b)
+
+    (a1, a2), (b1, b2) = _per_mode(monkeypatch, run)
+    np.testing.assert_array_equal(a1, b1)
+    np.testing.assert_array_equal(a2, b2)
+    # ground truth
+    ref = np.empty(n, np.int32)
+    ref[np.asarray(perm)] = np.asarray(f1)
+    np.testing.assert_array_equal(a1, ref)
+
+
+@pytest.mark.parametrize("jt", [JoinType.INNER, JoinType.LEFT,
+                                JoinType.RIGHT, JoinType.FULL_OUTER])
+def test_join_gather_agree(monkeypatch, jt):
+    rng = np.random.default_rng(int(jt.value) + 1)
+    cap = 1 << 10
+    lk = rng.integers(0, 200, cap).astype(np.int32)
+    lv = rng.random(cap).astype(np.float32)
+    rk = rng.integers(0, 200, cap).astype(np.int32)
+    rv = rng.random(cap).astype(np.float32)
+    cols_l = (colmod.from_numpy(lk), colmod.from_numpy(lv))
+    cols_r = (colmod.from_numpy(rk), colmod.from_numpy(rv))
+    count = jnp.asarray(cap - 13, jnp.int32)
+
+    def run():
+        m = int(join_mod.join_row_count(cols_l, count, cols_r, count,
+                                        (0,), (0,), jt, "sort"))
+        out, n = join_mod.join_gather(cols_l, count, cols_r, count,
+                                      (0,), (0,), jt, 1 << 14, "sort")
+        n = int(n)
+        rows = [tuple(np.asarray(c.data)[:n][i] for c in out)
+                for i in range(n)]
+        return m, n, sorted(rows)
+
+    a, b = _per_mode(monkeypatch, run)
+    assert a[0] == b[0] and a[1] == b[1]
+    assert a[2] == b[2]
+
+
+def test_join_key_grouped_agree(monkeypatch):
+    rng = np.random.default_rng(99)
+    cap = 1 << 10
+    lk = rng.integers(0, 64, cap).astype(np.int32)
+    rk = rng.integers(0, 64, cap).astype(np.int32)
+    cols_l = (colmod.from_numpy(lk),)
+    cols_r = (colmod.from_numpy(rk),)
+    count = jnp.asarray(cap, jnp.int32)
+
+    def run():
+        out, n = join_mod.join_gather(cols_l, count, cols_r, count,
+                                      (0,), (0,), JoinType.INNER, 1 << 15,
+                                      "sort", key_grouped=True)
+        n = int(n)
+        return n, np.asarray(out[0].data)[:n]
+
+    a, b = _per_mode(monkeypatch, run)
+    assert a[0] == b[0]
+    # key_grouped output order is fully pinned by the combined sort
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+@pytest.mark.parametrize("keep", ["first", "last"])
+def test_unique_agree(monkeypatch, keep):
+    rng = np.random.default_rng(7 if keep == "first" else 8)
+    cap = 1 << 11
+    vals = rng.integers(0, 100, cap).astype(np.int32)
+    cols = (colmod.from_numpy(vals),)
+    count = jnp.asarray(cap - 9, jnp.int32)
+
+    def run():
+        out, m = unique_mod.unique(cols, count, (0,), keep=keep)
+        m = int(m)
+        return m, np.asarray(out[0].data)[:m]
+
+    a, b = _per_mode(monkeypatch, run)
+    assert a[0] == b[0]
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_slot_to_row_merge_matches_searchsorted():
+    rng = np.random.default_rng(3)
+    for cap_l, out_cap in ((1, 4), (100, 256), (1000, 2048)):
+        emit = rng.integers(0, 4, cap_l).astype(np.int32)
+        csum = np.cumsum(emit).astype(np.int32)
+        out_cap = max(out_cap, int(csum[-1]))
+        got = np.asarray(join_mod._slot_to_row_merge(
+            jnp.asarray(csum), out_cap))
+        want = np.searchsorted(csum, np.arange(out_cap), side="right")
+        np.testing.assert_array_equal(got, want.astype(np.int32))
+
+
+def test_permute_mode_default_by_backend(monkeypatch):
+    monkeypatch.delenv("CYLON_TPU_PERMUTE", raising=False)
+    want = "sort" if jax.default_backend() in ("tpu", "axon") else "scatter"
+    assert compact.permute_mode() == want
+    monkeypatch.setenv("CYLON_TPU_PERMUTE", "sort")
+    assert compact.permute_mode() == "sort"
+    monkeypatch.setenv("CYLON_TPU_PERMUTE", "scatter")
+    assert compact.permute_mode() == "scatter"
