@@ -105,11 +105,12 @@ def _sort_variant(label, env):
         for k in env:
             os.environ.pop(k, None)
 
-_sort_variant("combined sort RADIX d=1", {"CYLON_TPU_SORT": "radix"})
-_sort_variant("combined sort RADIX d=2",
-              {"CYLON_TPU_SORT": "radix", "CYLON_TPU_RADIX_BITS": "2"})
-_sort_variant("combined sort RADIX d=1 xla-scan",
-              {"CYLON_TPU_SORT": "radix", "CYLON_TPU_RADIX_SCAN": "xla"})
+if not os.environ.get("CYLON_TPU_PROFILE_SKIP_RADIX"):
+    _sort_variant("combined sort RADIX d=1", {"CYLON_TPU_SORT": "radix"})
+    _sort_variant("combined sort RADIX d=2",
+                  {"CYLON_TPU_SORT": "radix", "CYLON_TPU_RADIX_BITS": "2"})
+    _sort_variant("combined sort RADIX d=1 xla-scan",
+                  {"CYLON_TPU_SORT": "radix", "CYLON_TPU_RADIX_SCAN": "xla"})
 
 # -- stage 2: run extents (prefix arithmetic) ------------------------------
 @jax.jit
@@ -120,21 +121,44 @@ def stage_extents(perm, new_group, is_run_end, live_sorted):
 extents = timed("run extents (cumsum+cummax+cummin)", stage_extents,
                 *sorted_parts)
 
-# -- stage 3: back-scatter + compactions -----------------------------------
+# -- stage 3: back-map + partition (the real _match_ranges tail) -----------
+# Realized per compact.permute_mode() — the inverse-permute back-map and
+# the right/left partition are the scatters the sort mode replaces.
 @jax.jit
 def stage_back(perm, lo_sorted, matches_sorted):
-    n = 2 * cap
-    back = jnp.zeros((n, 2), jnp.int32).at[perm].set(
-        jnp.stack([lo_sorted, matches_sorted], axis=1))
+    back = compact.inverse_permute(perm, lo_sorted, matches_sorted)
     is_right = perm >= cap
-    idx_r, _ = compact.compact_indices(is_right)
-    perm_r = jnp.take(perm, idx_r[:cap]) - cap
-    idx_l, _ = compact.compact_indices(~is_right)
-    left_key_order = jnp.take(perm, idx_l[:cap])
+    part, _ = compact.partition_indices(is_right)
+    perm_r = jnp.take(perm, part[:cap]) - cap
+    left_key_order = jnp.take(perm, part[cap:])
     return back, perm_r, left_key_order
 
-timed("back-scatter + 2 compactions", stage_back, sorted_parts[0],
-      extents[0], extents[1])
+timed(f"back-map + partition ({compact.permute_mode()})", stage_back,
+      sorted_parts[0], extents[0], extents[1])
+
+
+def _permute_variant(label, mode):
+    """Re-time the back-map stage under the other permute realization."""
+    os.environ["CYLON_TPU_PERMUTE"] = mode
+
+    @jax.jit
+    def stage(perm, lo_sorted, matches_sorted):
+        back = compact.inverse_permute(perm, lo_sorted, matches_sorted)
+        is_right = perm >= cap
+        part, _ = compact.partition_indices(is_right)
+        return back, jnp.take(perm, part[:cap]) - cap
+
+    try:
+        timed(label, stage, sorted_parts[0], extents[0], extents[1])
+    except Exception as e:
+        print(f"{label:34s} FAILED: {type(e).__name__}: {str(e)[:200]}",
+              flush=True)
+    finally:
+        os.environ.pop("CYLON_TPU_PERMUTE", None)
+
+
+other = "scatter" if compact.permute_mode() == "sort" else "sort"
+_permute_variant(f"back-map + partition ({other})", other)
 
 # -- full join_gather ------------------------------------------------------
 # same SEED and data recipe as bench.py, so its verified join-count cache

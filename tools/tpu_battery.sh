@@ -2,16 +2,17 @@
 # Unattended TPU measurement battery — run when the axon tunnel is up
 # (tools/tpu_watch.sh polls and fires this automatically).
 #
-# ROUND-4 ORDERING: outages last hours and a window may be short, so the
-# steps land in VERDICT-priority order — headline number first, then the
-# stage profile that sizes the sort bottleneck (incl. the radix A/B), then
-# the radix-mode driver metric, then the FIRST-EVER 1B-row out-of-core
-# measurement, then the secondary experiments.
+# ROUND-4b ORDERING (after the first live window settled the radix bet:
+# lax.sort 213 ms vs 34 scatter passes 33.7 s at 32M rows/side — scatters,
+# not the sort, dominate this backend): headline bench under the new
+# sort-realized-permutation default first, its scatter-mode A/B second,
+# then the FIRST-EVER 1B-row out-of-core measurement, then the stage
+# profile and secondary experiments.
 #
 # Produces under $OUT (default /tmp/battery):
-#   bench_sort.json profile.txt bench_radix.json bench_chunked.json
-#   bench_hash.json bench_climb.json bench_prefix.json smoke.json
-#   baselines_full.json
+#   bench_permsort.json bench_permscatter.json bench_chunked.json
+#   profile_sort.txt bench_hash.json bench_climb.json bench_prefix.json
+#   smoke.json baselines_full.json
 # Each step is independently timeout-guarded so one hang cannot eat the rest.
 set -u
 cd "$(dirname "$0")/.."
@@ -19,28 +20,26 @@ OUT=${1:-/tmp/battery}
 mkdir -p "$OUT"
 log() { echo "[battery $(date +%H:%M:%S)] $*"; }
 
-# bench.py enforces its own internal deadline (CYLON_BENCH_BUDGET_S) and
-# emits a valid line on SIGTERM/SIGALRM, so guards are budget + slack.
-log "1/9 bench (sort algorithm, default ladder) — headline driver metric"
+log "1/9 bench (DEFAULT = sort-realized permutations on TPU) — headline"
 CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
-    > "$OUT/bench_sort.json" 2> "$OUT/bench_sort.log"
-log "bench sort rc=$? $(head -c 200 "$OUT/bench_sort.json" 2>/dev/null)"
+    > "$OUT/bench_permsort.json" 2> "$OUT/bench_permsort.log"
+log "bench perm-sort rc=$? $(head -c 200 "$OUT/bench_permsort.json" 2>/dev/null)"
 
-log "2/9 stage profile at 32M rows/side (incl. cmp-vs-radix sort A/B)"
-timeout 2400 python tools/profile_pipeline.py 33554432 \
-    > "$OUT/profile.txt" 2> "$OUT/profile.log"
-log "profile rc=$?"
+log "2/9 bench (CYLON_TPU_PERMUTE=scatter) — the pre-round-4b path, live A/B"
+CYLON_TPU_PERMUTE=scatter CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
+    > "$OUT/bench_permscatter.json" 2> "$OUT/bench_permscatter.log"
+log "bench perm-scatter rc=$? $(head -c 200 "$OUT/bench_permscatter.json" 2>/dev/null)"
 
-log "3/9 bench (radix sort mode, default ladder) — live A/B vs step 1"
-CYLON_TPU_SORT=radix CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
-    > "$OUT/bench_radix.json" 2> "$OUT/bench_radix.log"
-log "bench radix rc=$? $(head -c 200 "$OUT/bench_radix.json" 2>/dev/null)"
-
-log "4/9 bench chunked (out-of-core, 2^29 rows/side = 1.07B total, 16 passes)"
+log "3/9 bench chunked (out-of-core, 2^29 rows/side = 1.07B total, 16 passes)"
 CYLON_BENCH_ROWS=536870912,268435456 CYLON_BENCH_PASSES=16 \
     CYLON_BENCH_BUDGET_S=5000 timeout 5100 python bench.py \
     > "$OUT/bench_chunked.json" 2> "$OUT/bench_chunked.log"
 log "bench chunked rc=$? $(head -c 200 "$OUT/bench_chunked.json" 2>/dev/null)"
+
+log "4/9 stage profile at 32M rows/side (sort-permute default)"
+CYLON_TPU_PROFILE_SKIP_RADIX=1 timeout 2400 python tools/profile_pipeline.py 33554432 \
+    > "$OUT/profile_sort.txt" 2> "$OUT/profile_sort.log"
+log "profile rc=$?"
 
 log "5/9 bench (hash algorithm, one size down)"
 CYLON_BENCH_ALGO=hash CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
