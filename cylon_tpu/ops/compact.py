@@ -101,6 +101,25 @@ def partition_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return perm, nt
 
 
+def count_leq_dense(sorted_vals: jax.Array, num_queries: int) -> jax.Array:
+    """``out[k] = #{i : sorted_vals[i] <= k}`` for k in [0, num_queries) —
+    ``searchsorted(sorted_vals, arange(num_queries), side='right')`` for a
+    monotone int array — via one merged u32 sort plus one packed
+    compaction (both bandwidth-bound on TPU, unlike a scatter/histogram).
+
+    Packing: word = value << 1 | tag (tag 1 = query).  A value v sorts
+    before query k exactly when v <= k, and queries keep their ascending
+    order, so query k's merged position p satisfies p = #{v <= k} + k.
+    Values are clipped to num_queries (entries beyond every query count
+    toward no query, preserving searchsorted semantics for the dense
+    query range)."""
+    vals = jnp.clip(sorted_vals, 0, num_queries).astype(jnp.uint32) << 1
+    queries = (jnp.arange(num_queries, dtype=jnp.uint32) << 1) | 1
+    merged = jax.lax.sort(jnp.concatenate([vals, queries]), is_stable=False)
+    p, _ = compact_indices((merged & 1) == 1)
+    return p[:num_queries] - jnp.arange(num_queries, dtype=jnp.int32)
+
+
 def inverse_permute(perm: jax.Array, *fields: jax.Array) -> Tuple[jax.Array, ...]:
     """``out[perm[i]] = fields[..][i]`` for each field — the inverse-
     permutation apply (``perm`` must be a permutation of [0, n)).

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from .. import dtypes, precision
 from ..column import Column
-from . import keys, segments
+from . import compact, keys, segments
 
 
 class AggOp(enum.IntEnum):
@@ -267,8 +267,20 @@ def _nunique(vcol: Column, vvalid, gid, cap: int):
     svalid = jnp.take(vvalid, perm)
     gsorted = jnp.take(gid, perm)
     new_distinct = (~eq) & svalid
-    # i32 scatter-add, widened after: 64-bit scatters are ~8x slower on TPU
-    cnt = jax.ops.segment_sum(new_distinct.astype(jnp.int32), gsorted, cap)
+    if compact.permute_mode() == "sort":
+        # valid rows sort first (primary operand ~vvalid), so the valid
+        # prefix is gid-ascending: per-gid distinct counts are prefix-sum
+        # differences at merged-searchsorted group bounds — no scatter
+        gclean = jnp.where(svalid, gsorted, cap)
+        ub = compact.count_leq_dense(gclean, cap)
+        p0 = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(new_distinct.astype(jnp.int32))])
+        e = jnp.take(p0, ub)  # distinct count up to each group's end
+        cnt = e - jnp.concatenate([jnp.zeros((1,), jnp.int32), e[:-1]])
+    else:
+        # i32 scatter-add, widened after: 64-bit scatters are ~8x slower
+        cnt = jax.ops.segment_sum(new_distinct.astype(jnp.int32), gsorted,
+                                  cap)
     return (cnt if precision.narrow() else cnt.astype(jnp.int64)), cnt
 
 

@@ -104,25 +104,6 @@ def _match_ranges(cols_l, count_l, cols_r, count_r, left_on, right_on,
     return lo, matches, perm_r, live_l, unmatched_r, left_key_order
 
 
-def _slot_to_row_merge(csum: jax.Array, out_capacity: int) -> jax.Array:
-    """``li[k] = #{i : csum[i] <= k}`` for k in [0, out_capacity) — i.e.
-    ``searchsorted(csum, k, side='right')`` with csum monotone — via one
-    merged u32 sort plus one packed compaction (both bandwidth-bound on
-    TPU, unlike the scatter this replaces).
-
-    Packing: word = value << 1 | tag (tag 1 = slot query).  A csum entry
-    v sorts before slot k exactly when v <= k, and slots keep their
-    ascending order, so slot k's merged position p satisfies
-    p = #{v <= k} + k.  Slot positions in ascending k order are the
-    tag-set positions in merged order — one mask compaction."""
-    cap_l = csum.shape[0]
-    vals = jnp.clip(csum, 0, out_capacity).astype(jnp.uint32) << 1
-    slots = (jnp.arange(out_capacity, dtype=jnp.uint32) << 1) | 1
-    merged = jax.lax.sort(jnp.concatenate([vals, slots]), is_stable=False)
-    p, _ = compact.compact_indices((merged & 1) == 1)
-    return p[:out_capacity] - jnp.arange(out_capacity, dtype=jnp.int32)
-
-
 def _emission(matches, live_l, join_type: JoinType):
     outer_left = join_type in (JoinType.LEFT, JoinType.FULL_OUTER)
     emit = jnp.where(live_l & (matches == 0), jnp.int32(1 if outer_left else 0), matches)
@@ -209,9 +190,8 @@ def join_gather(cols_l: Tuple[Column, ...], count_l,
         # slot -> left row is searchsorted(csum, k, 'right') — csum is
         # monotone, so slot k's emitter is the count of rows with
         # csum <= k.  Realized as a sort-merge (sorts beat scatters on
-        # TPU): tag-bit-packed csum values and slot ids share ONE u32
-        # sort; slot k's merged position p gives li = p - k.
-        li = _slot_to_row_merge(csum, out_capacity)
+        # TPU; see compact.count_leq_dense).
+        li = compact.count_leq_dense(csum, out_capacity)
     else:
         # scatter + cummax forward fill: each emitting row drops its index
         # at its first output slot (bases are distinct and ascending),

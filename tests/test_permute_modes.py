@@ -13,6 +13,7 @@ splitters).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pandas as pd
 import pytest
 
 from cylon_tpu import column as colmod
@@ -143,16 +144,44 @@ def test_unique_agree(monkeypatch, keep):
     np.testing.assert_array_equal(a[1], b[1])
 
 
-def test_slot_to_row_merge_matches_searchsorted():
+def test_count_leq_dense_matches_searchsorted():
     rng = np.random.default_rng(3)
     for cap_l, out_cap in ((1, 4), (100, 256), (1000, 2048)):
         emit = rng.integers(0, 4, cap_l).astype(np.int32)
         csum = np.cumsum(emit).astype(np.int32)
         out_cap = max(out_cap, int(csum[-1]))
-        got = np.asarray(join_mod._slot_to_row_merge(
-            jnp.asarray(csum), out_cap))
+        got = np.asarray(compact.count_leq_dense(jnp.asarray(csum), out_cap))
         want = np.searchsorted(csum, np.arange(out_cap), side="right")
         np.testing.assert_array_equal(got, want.astype(np.int32))
+
+
+def test_nunique_agree_across_modes(monkeypatch):
+    from cylon_tpu.ops import groupby as groupby_mod
+
+    rng = np.random.default_rng(21)
+    cap = 1 << 11
+    n = cap - 30
+    keys_np = rng.integers(0, 40, cap).astype(np.int32)
+    vals_np = rng.integers(0, 15, cap).astype(np.int32)
+    kcol = colmod.from_numpy(keys_np)
+    vcol = colmod.from_numpy(vals_np)
+    count = jnp.asarray(n, jnp.int32)
+
+    def run():
+        out, g = groupby_mod.hash_groupby(
+            (kcol, vcol), count, (0,),
+            ((1, groupby_mod.AggOp.NUNIQUE),))
+        g = int(g)
+        return g, np.asarray(out[0].data)[:g], np.asarray(out[1].data)[:g]
+
+    a, b = _per_mode(monkeypatch, run)
+    assert a[0] == b[0]
+    np.testing.assert_array_equal(a[1], b[1])
+    np.testing.assert_array_equal(a[2], b[2])
+    # pandas ground truth
+    want = (pd.DataFrame({"k": keys_np[:n], "v": vals_np[:n]})
+            .groupby("k")["v"].nunique())
+    np.testing.assert_array_equal(a[2], want.to_numpy())
 
 
 def test_permute_mode_default_by_backend(monkeypatch):
