@@ -251,8 +251,11 @@ def _worker(backend: str, skip: int = 0) -> int:
     except Exception as e:
         _log(f"compile cache unavailable: {e}")
 
-    plat = jax.devices()[0].platform
-    _log(f"worker backend={plat} devices={len(jax.devices())}")
+    dev0 = jax.devices()[0]
+    plat = dev0.platform
+    device_kind = getattr(dev0, "device_kind", "") or str(dev0)
+    _log(f"worker backend={plat} devices={len(jax.devices())} "
+         f"kind={device_kind!r}")
     if backend == "tpu" and plat not in ("tpu", "axon"):
         _log(f"expected tpu, got {plat}")
         return 3
@@ -273,6 +276,7 @@ def _worker(backend: str, skip: int = 0) -> int:
         from cylon_tpu.ops import compact as _compact
 
         frag = {"value": value, "rows": rows, "backend": plat,
+                "device_kind": device_kind,
                 "algo": os.environ.get("CYLON_BENCH_ALGO", "sort"),
                 "sort_mode": os.environ.get("CYLON_TPU_SORT", "cmp"),
                 "segsum": segsum,

@@ -1,0 +1,100 @@
+"""Primitive-op cost model for the current backend.
+
+Times the building blocks every kernel composes — sorts (1/2/3 operand),
+gathers (random / sorted indices), scatters (permute-set / add), scans
+(cumsum / cummax) — at N elements, so design choices (permute_mode,
+segsum mode, sort-vs-scatter realizations) rest on measured per-op costs
+instead of folklore.  Round-4 motivation: the first hardware window
+showed lax.sort at 213 ms vs ~900 ms per permuting scatter at 64M
+elements, inverting the CPU cost model.
+
+Usage: python tools/microbench.py [n_elements]   (default 2^26)
+Prints one line per op: name, ms (best of 3), GB/s of minimal traffic.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO_ROOT, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 26)
+REPS = 3
+
+rng = np.random.default_rng(5)
+dev0 = jax.devices()[0]
+print(f"backend={dev0.platform} kind={getattr(dev0, 'device_kind', dev0)} "
+      f"n={N}", flush=True)
+
+a = jnp.asarray(rng.integers(0, 1 << 30, N, dtype=np.int64).astype(np.uint32))
+b = jnp.asarray(rng.integers(0, 1 << 30, N, dtype=np.int64).astype(np.uint32))
+c = jnp.asarray(rng.random(N).astype(np.float32))
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+sorted_idx = jnp.asarray(np.sort(rng.integers(0, N, N)).astype(np.int32))
+seg = jnp.asarray(np.sort(rng.integers(0, N // 8 or 1, N)).astype(np.int32))
+
+
+def timed(name, fn, *args, traffic_bytes=None):
+    f = jax.jit(fn)
+    try:
+        out = f(*args)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(jax.device_get(leaf[:1]))  # force completion
+        ts = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            out = f(*args)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            np.asarray(jax.device_get(leaf[:1]))
+            ts.append(time.perf_counter() - t0)
+        ms = min(ts) * 1e3
+        gbs = ""
+        if traffic_bytes:
+            gbs = f"{traffic_bytes / (ms / 1e3) / 1e9:8.1f} GB/s(min)"
+        print(f"{name:36s} {ms:10.1f} ms {gbs}", flush=True)
+    except Exception as e:
+        print(f"{name:36s} FAILED: {type(e).__name__}: {str(e)[:160]}",
+              flush=True)
+
+
+B4 = 4 * N
+timed("sort 1-op u32", lambda x: jax.lax.sort(x, is_stable=False), a,
+      traffic_bytes=2 * B4)
+timed("sort 2-op (1 key) u32", lambda x, y: jax.lax.sort(
+    (x, y), num_keys=1, is_stable=False), a, b, traffic_bytes=4 * B4)
+timed("sort 3-op (1 key)", lambda x, y, z: jax.lax.sort(
+    (x, y, z), num_keys=1, is_stable=False), a, b, c,
+    traffic_bytes=6 * B4)
+timed("sort 2-op stable (2 keys)", lambda x, y: jax.lax.sort(
+    (x, y), num_keys=2, is_stable=True), a, b, traffic_bytes=4 * B4)
+timed("gather random (take)", lambda x, i: jnp.take(x, i), c, perm,
+      traffic_bytes=3 * B4)
+timed("gather sorted idx (take)", lambda x, i: jnp.take(x, i), c,
+      sorted_idx, traffic_bytes=3 * B4)
+timed("scatter-set permutation", lambda x, i: jnp.zeros_like(x).at[i].set(
+    x, unique_indices=True, mode="promise_in_bounds"), c, perm,
+    traffic_bytes=3 * B4)
+timed("scatter-add segments", lambda x, i: jnp.zeros((N // 8 or 1,),
+      jnp.float32).at[i].add(x), c, seg, traffic_bytes=3 * B4)
+timed("segment_sum (jax.ops)", lambda x, i: jax.ops.segment_sum(
+    x, i, N // 8 or 1), c, seg, traffic_bytes=3 * B4)
+timed("cumsum f32", jnp.cumsum, c, traffic_bytes=2 * B4)
+timed("cumsum i32", lambda x: jnp.cumsum(x.astype(jnp.int32)), a,
+      traffic_bytes=2 * B4)
+timed("cummax i32", lambda x: jax.lax.cummax(x.astype(jnp.int32)), a,
+      traffic_bytes=2 * B4)
+timed("associative_scan (sum,flag)", lambda x, f: jax.lax.associative_scan(
+    lambda p, q: (jnp.where(q[1], q[0], p[0] + q[0]), p[1] | q[1]),
+    (x, f)), c, a < (1 << 27), traffic_bytes=4 * B4)
+timed("elementwise a*b+c", lambda x, y: x * y + 1.0, c, c,
+      traffic_bytes=3 * B4)
+print("done", flush=True)

@@ -30,6 +30,11 @@ CYLON_TPU_PERMUTE=scatter CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py
     > "$OUT/bench_permscatter.json" 2> "$OUT/bench_permscatter.log"
 log "bench perm-scatter rc=$? $(head -c 200 "$OUT/bench_permscatter.json" 2>/dev/null)"
 
+log "2b/9 primitive-op microbench at 2^26 (sort/gather/scatter/scan cost model)"
+timeout 900 python tools/microbench.py 67108864 \
+    > "$OUT/microbench.txt" 2> "$OUT/microbench.log"
+log "microbench rc=$?"
+
 log "3/9 bench chunked (out-of-core, 2^29 rows/side = 1.07B total, 16 passes)"
 CYLON_BENCH_ROWS=536870912,268435456 CYLON_BENCH_PASSES=16 \
     CYLON_BENCH_BUDGET_S=5000 timeout 5100 python bench.py \

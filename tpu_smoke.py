@@ -44,6 +44,8 @@ def main() -> int:
 
     plat = jax.devices()[0].platform
     results["platform"] = plat
+    results["device_kind"] = (getattr(jax.devices()[0], "device_kind", "")
+                              or str(jax.devices()[0]))
     if plat not in ("tpu", "axon"):
         print(json.dumps({"error": f"not a TPU: {plat}"}))
         return 2
