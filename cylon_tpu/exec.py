@@ -878,6 +878,169 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
     return result, stats
 
 
+def chunked_repartition(data, keys, world: int, *, passes: int = 4,
+                        out_dir: "str | None" = None, ctx=None):
+    """Out-of-core hash repartition of one host frame into ``world`` hash
+    shards, streamed through the device in ``passes`` passes — BASELINE
+    config 3 ("1B-row hash shuffle / repartition") at beyond-HBM scale on
+    one chip.  Each pass rides the SAME kernels as the distributed
+    shuffle's local half (reference partition.cpp:24-87 + Split,
+    arrow_kernels.hpp:60-96): Pallas murmur3 targets + the stable
+    per-target split — so concatenating a target's per-pass slices yields
+    exactly the shard the mesh shuffle would deliver to that rank (the
+    device hasher is bit-identical to the native host hasher).
+
+    Passes stripe the input by contiguous row blocks (target assignment
+    is per-row, so any disjoint pass split is valid — striping keeps the
+    host side at slice cost, no selection pass).
+
+    With ``out_dir``, each (target, pass) slice lands in
+    ``{out_dir}/shard_{t}/part_{p:04d}.parquet`` and only counts are kept
+    in memory; otherwise per-target host columns are returned.
+
+    With a distributed ``ctx`` each pass instead runs the REAL mesh
+    shuffle; ``world`` must equal the context's world size (the mesh
+    defines the shard count).  On a true multi-HOST mesh the return mode
+    covers only this process's shards — use ``out_dir`` (each process
+    writes its own shard files, gather-free) for the global result.
+
+    Returns (list of ``world`` per-target host-column dicts | None when
+    ``out_dir`` is given, stats)."""
+    t0 = time.perf_counter()
+    names, arrs = _as_host_frame(data)
+    key_names = _resolve_keys(names, keys, None, "partition")
+    key_idx = tuple(names.index(n) for n in key_names)
+    if world < 1:
+        raise CylonError(Code.Invalid, f"world must be >= 1, got {world}")
+    n_rows = int(np.asarray(arrs[names[0]]).shape[0]) if names else 0
+    n_passes = max(1, min(passes, max(1, n_rows)))
+    block = -(-n_rows // n_passes)
+    cap = pow2ceil(max(8, block))
+
+    wctx = 1 if ctx is None else ctx.GetWorldSize()
+    if out_dir is not None:
+        os.makedirs(out_dir, exist_ok=True)
+
+    widths = {n: _str_width(a) for n, a in arrs.items()
+              if np.asarray(a).dtype.kind in "USO"}
+
+    def slice_chunk(p: int):
+        lo, hi = p * block, min((p + 1) * block, n_rows)
+        cols = tuple(colmod.from_numpy(
+            np.asarray(arrs[n])[lo:hi], capacity=cap,
+            string_width=widths.get(n, colmod.DEFAULT_STRING_WIDTH))
+            for n in names)
+        return cols, jnp.asarray(hi - lo, jnp.int32)
+
+    def empty_chunk():
+        cols = tuple(colmod.from_numpy(
+            np.asarray(arrs[n])[:0], capacity=cap,
+            string_width=widths.get(n, colmod.DEFAULT_STRING_WIDTH))
+            for n in names)
+        return cols, jnp.asarray(0, jnp.int32)
+
+    acc: "List[List[Dict[str, np.ndarray]]]" = [[] for _ in range(world)]
+    per_target = np.zeros(world, np.int64)
+
+    if wctx > 1:
+        from .table import Table
+
+        if world != wctx:
+            raise CylonError(Code.Invalid,
+                             f"world {world} != distributed context world "
+                             f"{wctx}: with ctx the mesh defines the shard "
+                             f"count")
+        if out_dir is not None:
+            for t in range(world):
+                os.makedirs(os.path.join(out_dir, f"shard_{t}"),
+                            exist_ok=True)
+        t_plan = time.perf_counter() - t0
+        t_run0 = time.perf_counter()
+        total = 0
+        for p in range(n_passes):
+            lo, hi = p * block, min((p + 1) * block, n_rows)
+            t = Table.from_numpy(names, [np.asarray(arrs[n])[lo:hi]
+                                         for n in names], ctx=ctx,
+                                 capacity=cap)
+            s = t.shuffle(key_names)
+            total += s.row_count
+            if out_dir is not None:
+                # same shard_{t}/part_{p}.parquet layout as single-chip
+                s.to_parquet(os.path.join(out_dir, "shard_{shard}",
+                                          f"part_{p:04d}.parquet"),
+                             per_shard=True)
+            else:
+                for sid, scols, cnt in s._addressable_host_shards():
+                    frame = {name: colmod.to_numpy(c, cnt)
+                             for name, c in zip(names, scols)}
+                    per_target[sid] += cnt
+                    acc[sid].append(frame)
+        result = (None if out_dir is not None
+                  else [_concat_host(fs) for fs in acc])
+        t_run = time.perf_counter() - t_run0
+        stats = {"passes": n_passes, "world": wctx, "rows": total,
+                 "per_target": per_target.tolist(),
+                 "plan_seconds": t_plan, "run_seconds": t_run,
+                 "total_seconds": t_plan + t_run}
+        return result, stats
+
+    from .parallel import partition as partition_mod
+    from .parallel import shuffle as shuffle_mod
+
+    @jax.jit
+    def prog(cols, cnt):
+        t = partition_mod.hash_targets(cols, cnt, key_idx, world)
+        perm_t = shuffle_mod._perm_by_target(t, world)
+        counts = shuffle_mod.target_counts(t, world)
+        grouped = tuple(c.take(perm_t) for c in cols)
+        return grouped, counts
+
+    def fetch_and_store(out, p: int) -> int:
+        grouped, counts = out
+        cnts = np.asarray(jax.device_get(counts))
+        n = int(cnts.sum())
+        frame = {name: colmod.to_numpy(c, n)
+                 for name, c in zip(names, grouped)}
+        offs = np.concatenate([[0], np.cumsum(cnts)]).astype(np.int64)
+        for t in range(world):
+            sl = {name: a[offs[t]:offs[t + 1]] for name, a in frame.items()}
+            per_target[t] += offs[t + 1] - offs[t]
+            if out_dir is not None:
+                import pandas as pd
+
+                d = os.path.join(out_dir, f"shard_{t}")
+                os.makedirs(d, exist_ok=True)
+                pd.DataFrame(sl).to_parquet(
+                    os.path.join(d, f"part_{p:04d}.parquet"))
+            else:
+                acc[t].append(sl)
+        return n
+
+    warm = empty_chunk()
+    jax.block_until_ready(prog(*warm))
+    del warm
+    t_plan = time.perf_counter() - t0
+    prefetch = os.environ.get("CYLON_TPU_PREFETCH", "1") != "0"
+    t_run0 = time.perf_counter()
+    total = 0
+    nxt = slice_chunk(0) if prefetch else None
+    for p in range(n_passes):
+        cur = nxt if prefetch else slice_chunk(p)
+        fut = prog(*cur)
+        nxt = slice_chunk(p + 1) if prefetch and p + 1 < n_passes else None
+        total += fetch_and_store(fut, p)
+        del cur, fut
+    del nxt
+    t_run = time.perf_counter() - t_run0
+    result = (None if out_dir is not None
+              else [_concat_host(fs) for fs in acc])
+    stats = {"passes": n_passes, "world": world, "rows": total,
+             "per_target": per_target.tolist(),
+             "plan_seconds": t_plan, "run_seconds": t_run,
+             "total_seconds": t_plan + t_run}
+    return result, stats
+
+
 def chunked_unique(data, columns=None, *, passes: int = 4,
                    mode: str = "auto", ctx=None):
     """Out-of-core distinct rows over the given columns (default: all):

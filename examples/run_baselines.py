@@ -40,6 +40,11 @@ def main() -> int:
         ("shuffle", lambda: shuffle_bench.run(
             p["shuffle_rows"],
             out_dir="/tmp/shuffle_out" if preset == "full" else None)),
+        # config 3 at STATED scale (1B rows) — single-chip out-of-core
+        ("shuffle_ooc", (lambda: shuffle_bench.run_ooc(
+            int(os.environ.get("CYLON_SHUFFLE_OOC_ROWS", str(1 << 30)))))
+            if preset == "full" else (lambda: shuffle_bench.run_ooc(
+                1 << 18, world=4, passes=4))),
         ("tpch_q5", q5),
         ("etl_to_flax", lambda: etl_to_flax.run(p["events"])),
     ]:

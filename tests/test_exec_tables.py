@@ -419,3 +419,89 @@ def test_chunked_nunique_partial_rejected(rng):
         chunked_join_groupby_tables(
             left, right, on="cust", how="inner", group_by="nation",
             agg={"amount": ["nunique"]}, passes=4)
+
+
+def test_chunked_repartition_matches_device_hash(rng, tmp_path):
+    """Per-target slices must agree with the device hash assignment the
+    mesh shuffle uses (hash_targets), and the union must be the input."""
+    from cylon_tpu import column as colmod
+    from cylon_tpu.exec import chunked_repartition
+    from cylon_tpu.parallel import partition as partition_mod
+
+    import jax.numpy as jnp
+
+    n, world = 6000, 4
+    df = pd.DataFrame({"k": rng.integers(-1000, 1000, n).astype(np.int32),
+                       "v": rng.random(n).astype(np.float32),
+                       "s": np.asarray([f"x{rng.integers(0, 9)}"
+                                        for _ in range(n)], dtype=object)})
+    parts, stats = chunked_repartition(df, "k", world, passes=5)
+    assert stats["rows"] == n
+    assert sum(stats["per_target"]) == n
+    assert len(parts) == world
+
+    # ground truth target per row from the same device kernel
+    col = colmod.from_numpy(df["k"].to_numpy())
+    t = np.asarray(partition_mod.hash_targets(
+        (col,), jnp.asarray(n, jnp.int32), (0,), world))
+    for w in range(world):
+        want = df[t == w]
+        got_rows = sorted(zip(parts[w]["k"].tolist(),
+                              np.round(parts[w]["v"].astype(float), 4),
+                              parts[w]["s"].tolist()))
+        want_rows = sorted(zip(want["k"].tolist(),
+                               np.round(want["v"].astype(float), 4),
+                               want["s"].tolist()))
+        assert got_rows == want_rows, f"target {w} mismatch"
+
+    # file mode: per-(target, pass) parquet, counts only
+    out = tmp_path / "parts"
+    none_res, st2 = chunked_repartition(df, "k", world, passes=3,
+                                        out_dir=str(out))
+    assert none_res is None and st2["rows"] == n
+    back = []
+    for w in range(world):
+        files = sorted((out / f"shard_{w}").glob("part_*.parquet"))
+        assert files, f"no files for shard {w}"
+        back.append(pd.concat([pd.read_parquet(f) for f in files]))
+    assert sum(len(b) for b in back) == n
+    for w in range(world):
+        assert len(back[w]) == st2["per_target"][w]
+
+
+def test_chunked_repartition_distributed(rng, tmp_path):
+    """ctx branch: per-target list matches mesh world; the documented
+    shard_{t}/part_{p}.parquet layout holds; world mismatch raises."""
+    from cylon_tpu import CylonContext, TPUConfig
+    from cylon_tpu.exec import chunked_repartition
+    from cylon_tpu.status import CylonError
+
+    ctx = CylonContext.InitDistributed(TPUConfig(world_size=4))
+    n = 3000
+    df = pd.DataFrame({"k": rng.integers(0, 500, n).astype(np.int32),
+                       "v": rng.random(n).astype(np.float32)})
+
+    with pytest.raises(CylonError, match="world"):
+        chunked_repartition(df, "k", 8, passes=2, ctx=ctx)
+
+    parts, st = chunked_repartition(df, "k", 4, passes=3, ctx=ctx)
+    assert st["rows"] == n and len(parts) == 4
+    assert sum(st["per_target"]) == n
+    # each key lands on exactly one target
+    seen = {}
+    for t, p in enumerate(parts):
+        for kid in np.unique(p["k"]):
+            assert seen.setdefault(int(kid), t) == t
+    allk = np.sort(np.concatenate([p["k"] for p in parts]))
+    np.testing.assert_array_equal(allk, np.sort(df["k"].to_numpy()))
+
+    out = tmp_path / "dist"
+    none_res, st2 = chunked_repartition(df, "k", 4, passes=2, ctx=ctx,
+                                        out_dir=str(out))
+    assert none_res is None and st2["rows"] == n
+    total = 0
+    for w in range(4):
+        files = sorted((out / f"shard_{w}").glob("part_*.parquet"))
+        assert files, f"no files for shard {w}"
+        total += sum(len(pd.read_parquet(f)) for f in files)
+    assert total == n
