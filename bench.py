@@ -455,7 +455,7 @@ class _Bench:
             self.result = self._artifact(r, source)
         cur = self.cache.get("tpu")
         if r["backend"] in ("tpu", "axon") and r.get("algo", "sort") == "sort" \
-                and r.get("segsum", "scatter") == "scatter" \
+                and r.get("segsum", "prefix") == "prefix" \
                 and r.get("sort_mode", "cmp") == "cmp" \
                 and r.get("permute", "sort") == "sort" \
                 and not r.get("passes") \

@@ -920,6 +920,14 @@ def chunked_repartition(data, keys, world: int, *, passes: int = 4,
     wctx = 1 if ctx is None else ctx.GetWorldSize()
     if out_dir is not None:
         os.makedirs(out_dir, exist_ok=True)
+        # a reused out_dir must not mix this run's parts with a prior
+        # run's (e.g. an earlier run with more passes): clear OUR layout
+        # only — part files under shard_* dirs — never foreign files
+        import glob as _glob
+
+        for stale in _glob.glob(os.path.join(out_dir, "shard_*",
+                                             "part_*.parquet")):
+            os.remove(stale)
 
     widths = {n: _str_width(a) for n, a in arrs.items()
               if np.asarray(a).dtype.kind in "USO"}
@@ -969,6 +977,10 @@ def chunked_repartition(data, keys, world: int, *, passes: int = 4,
                 s.to_parquet(os.path.join(out_dir, "shard_{shard}",
                                           f"part_{p:04d}.parquet"),
                              per_shard=True)
+                from .table import _host_row_counts
+
+                per_target[:] += np.asarray(_host_row_counts(s),
+                                            np.int64)[:world]
             else:
                 for sid, scols, cnt in s._addressable_host_shards():
                     frame = {name: colmod.to_numpy(c, cnt)

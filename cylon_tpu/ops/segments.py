@@ -115,17 +115,26 @@ def set_segsum(mode: "str | None") -> None:
 
 
 def prefix_reductions_enabled() -> bool:
-    """CYLON_TPU_SEGSUM=prefix (or set_segsum) flips narrow-mode
-    float/min/max segment reductions from scatter-adds to the segmented
-    scan below (A/B knob — scatter serializes on TPU, the scan is
-    log-depth; default stays scatter until measured on hardware).  Read at
-    trace time: set it before the first jitted compute or use set_segsum,
+    """Whether narrow-mode float/min/max segment reductions use the
+    segmented scan below instead of scatter-adds.  CYLON_TPU_SEGSUM
+    (or set_segsum) forces "prefix"/"scatter"; the default is
+    backend-aware like compact.permute_mode — prefix on TPU-family
+    backends (round-4 hardware: XLA:TPU serializes scatters; a same-size
+    scan is log-depth and bandwidth-bound), scatter elsewhere (XLA:CPU
+    scatter-adds are cheap and its associative_scan is not).  The
+    64-bit carve-outs in groupby._segment_aggregate are mode-independent:
+    integer sums and wide accumulators keep the scatter in every mode
+    (64-bit prefix fusions have crashed this TPU backend).  Read at trace
+    time: set it before the first jitted compute or use set_segsum,
     which clears the jit caches."""
     if _SEGSUM_MODE is not None:
         return _SEGSUM_MODE == "prefix"
     import os
 
-    return os.environ.get("CYLON_TPU_SEGSUM") == "prefix"
+    mode = os.environ.get("CYLON_TPU_SEGSUM")
+    if mode in ("prefix", "scatter"):
+        return mode == "prefix"
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def segmented_reduce_sorted(x: jax.Array, new_group: jax.Array,

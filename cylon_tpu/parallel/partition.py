@@ -105,7 +105,17 @@ def range_targets(col: Column, count, world: int, *, num_bins: int,
 
     sbin = jnp.clip(((sample - gmin) / span * num_bins).astype(jnp.int32),
                     0, num_bins - 1)
-    hist = jax.ops.segment_sum(sample_ok.astype(jnp.int32), sbin, num_bins)
+    if compact_mod.permute_mode() == "sort":
+        # histogram as prefix-count differences (merged-sort searchsorted
+        # — count_leq_dense takes any input order); dead samples park in
+        # a clip-guaranteed in-range bin and are excluded by remapping
+        # them past every query
+        sbin_ok = jnp.where(sample_ok, sbin, num_bins)
+        leq = compact_mod.count_leq_dense(sbin_ok, num_bins)
+        hist = jnp.diff(leq, prepend=0).astype(jnp.int32)
+    else:
+        hist = jax.ops.segment_sum(sample_ok.astype(jnp.int32), sbin,
+                                   num_bins)
     hist = collectives.allreduce_sum(hist)          # global histogram (psum)
     total = jnp.maximum(jnp.sum(hist), 1)
 

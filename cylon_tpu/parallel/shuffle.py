@@ -39,7 +39,15 @@ from . import collectives
 
 def target_counts(targets: jax.Array, world: int) -> jax.Array:
     """int32[world]: rows this shard sends to each target (padding rows carry
-    target == world and fall off the end)."""
+    target == world and fall off the end).
+
+    sort permute mode: a fused compare-and-reduce over the tiny target
+    alphabet (the mesh width) — one bandwidth-bound pass, no scatter-add
+    (XLA:TPU serializes scatters; see compact.permute_mode)."""
+    if compact_mod.permute_mode() == "sort":
+        alphabet = jnp.arange(world, dtype=targets.dtype)
+        return jnp.sum(targets[:, None] == alphabet[None, :], axis=0,
+                       dtype=jnp.int32)
     ones = jnp.ones_like(targets, dtype=jnp.int32)
     return jax.ops.segment_sum(ones, targets, world + 1)[:world]
 

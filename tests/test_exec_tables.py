@@ -499,9 +499,20 @@ def test_chunked_repartition_distributed(rng, tmp_path):
     none_res, st2 = chunked_repartition(df, "k", 4, passes=2, ctx=ctx,
                                         out_dir=str(out))
     assert none_res is None and st2["rows"] == n
+    assert sum(st2["per_target"]) == n  # file mode must still count
     total = 0
     for w in range(4):
         files = sorted((out / f"shard_{w}").glob("part_*.parquet"))
         assert files, f"no files for shard {w}"
-        total += sum(len(pd.read_parquet(f)) for f in files)
+        got = sum(len(pd.read_parquet(f)) for f in files)
+        assert got == st2["per_target"][w]
+        total += got
     assert total == n
+
+    # re-running the SAME out_dir with fewer passes must not leave stale
+    # parts from the previous run in the shard dirs
+    _, st3 = chunked_repartition(df, "k", 4, passes=1, ctx=ctx,
+                                 out_dir=str(out))
+    readback = sum(len(pd.read_parquet(f)) for w in range(4)
+                   for f in (out / f"shard_{w}").glob("part_*.parquet"))
+    assert readback == n

@@ -25,8 +25,9 @@ CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
     > "$OUT/bench_permsort.json" 2> "$OUT/bench_permsort.log"
 log "bench perm-sort rc=$? $(head -c 200 "$OUT/bench_permsort.json" 2>/dev/null)"
 
-log "2/9 bench (CYLON_TPU_PERMUTE=scatter) — the pre-round-4b path, live A/B"
-CYLON_TPU_PERMUTE=scatter CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
+log "2/9 bench (FULL legacy: scatter permute + scatter segsum) — live A/B vs step 1"
+CYLON_TPU_PERMUTE=scatter CYLON_TPU_SEGSUM=scatter \
+    CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
     > "$OUT/bench_permscatter.json" 2> "$OUT/bench_permscatter.log"
 log "bench perm-scatter rc=$? $(head -c 200 "$OUT/bench_permscatter.json" 2>/dev/null)"
 
@@ -58,11 +59,11 @@ CYLON_BENCH_ROWS=268435456,134217728 CYLON_BENCH_BUDGET_S=2700 \
     > "$OUT/bench_climb.json" 2> "$OUT/bench_climb.log"
 log "bench climb rc=$? $(head -c 200 "$OUT/bench_climb.json" 2>/dev/null)"
 
-log "7/9 bench (segmented-scan reductions, one size down)"
-CYLON_TPU_SEGSUM=prefix CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
+log "7/9 bench (scatter segsum + sort permute, one size down — isolates segsum)"
+CYLON_TPU_SEGSUM=scatter CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
     timeout 1600 python bench.py \
-    > "$OUT/bench_prefix.json" 2> "$OUT/bench_prefix.log"
-log "bench prefix rc=$? $(head -c 200 "$OUT/bench_prefix.json" 2>/dev/null)"
+    > "$OUT/bench_segscatter.json" 2> "$OUT/bench_segscatter.log"
+log "bench segscatter rc=$? $(head -c 200 "$OUT/bench_segscatter.json" 2>/dev/null)"
 
 log "8/9 kernel smoke"
 timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"
