@@ -516,3 +516,36 @@ def test_chunked_repartition_distributed(rng, tmp_path):
     readback = sum(len(pd.read_parquet(f)) for w in range(4)
                    for f in (out / f"shard_{w}").glob("part_*.parquet"))
     assert readback == n
+
+
+@pytest.mark.parametrize("presort", ["0", "1"])
+def test_side_builder_presort_equivalence(rng, monkeypatch, presort):
+    """The presort (contiguous-slice) and mask chunk builders must emit
+    identical chunks — including pass order, string columns, and passes
+    past the planned id range."""
+    from cylon_tpu import column as colmod
+    from cylon_tpu.exec import _SideBuilder
+
+    monkeypatch.setenv("CYLON_TPU_CHUNK_PRESORT", presort)
+    n = 2000
+    arrs = {"k": rng.integers(0, 90, n).astype(np.int64),
+            "v": rng.random(n).astype(np.float32),
+            "s": np.asarray([f"row{rng.integers(0, 20)}" for _ in range(n)],
+                            dtype=object)}
+    pid = rng.integers(0, 5, n).astype(np.int32)
+    b = _SideBuilder(list(arrs), arrs, pid, 2048)
+    assert b.presort == (presort == "1")
+    for p in (0, 1, 4, 7):  # 7 is past every planned id -> empty
+        cols, cnt = b.chunk(p)
+        cnt = int(cnt)
+        assert cnt == int((pid == p).sum())
+        want_k = arrs["k"][pid == p]
+        np.testing.assert_array_equal(
+            colmod.to_numpy(cols[0], cnt).astype(np.int64), want_k)
+        assert list(colmod.to_numpy(cols[2], cnt)) \
+            == list(arrs["s"][pid == p])
+    # single-pass plan never pays the grouped copy
+    b1 = _SideBuilder(list(arrs), arrs, np.zeros(n, np.int32), 2048)
+    assert not b1.presort
+    cols, cnt = b1.chunk(0)
+    assert int(cnt) == n
