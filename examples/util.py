@@ -10,7 +10,17 @@ import sys
 def default_ctx(world: int | None = None):
     """Distributed context over all visible devices (or ``world`` of them);
     plain local context when only one device exists."""
+    import os
+
     import jax
+
+    try:  # persistent compile cache (shared with bench/profiler/smoke)
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
 
     from cylon_tpu import CylonContext, TPUConfig
 

@@ -15,9 +15,18 @@ import time
 
 
 def main() -> int:
+    import os
+
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    try:  # persistent compile cache: don't re-pay ~30s/kernel per window
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
 
     results = {"platform": None, "kernels": {}, "ok": False}
 
