@@ -76,6 +76,34 @@ def _make_data(rows: int):
 # worker: one measurement on the current process's backend
 # ---------------------------------------------------------------------------
 
+def make_bench_pipeline(out_cap: int, algo: str = "sort"):
+    """THE bench program — the single source for every consumer that must
+    lower the exact same pipeline (bench itself, tools/hbm_budget.py's
+    memory model, tools/profile_pipeline.py's fused stage): key_grouped
+    inner join + boundary-scan pipeline group-by, with projection
+    pushdown skipping the unused right-key output column's out_cap-sized
+    gather.  Reference driver shape:
+    cpp/src/examples/bench/table_join_dist_test.cpp:28-137."""
+    import jax
+
+    from cylon_tpu.config import JoinType
+    from cylon_tpu.ops import groupby as groupby_mod
+    from cylon_tpu.ops import join as join_mod
+    from cylon_tpu.ops.groupby import AggOp
+
+    @jax.jit
+    def pipeline(cl, cnt_l, cr, cnt_r):
+        joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
+                                          (0,), (0,), JoinType.INNER,
+                                          out_cap, algo, key_grouped=True,
+                                          project=(0, 1, 3))
+        gcols, g = groupby_mod.pipeline_groupby(
+            joined, jm, (0,), ((1, AggOp.SUM), (2, AggOp.MEAN)), 0)
+        return gcols[1].data, gcols[2].data, g, jm
+
+    return pipeline
+
+
 def _measure(rows: int) -> float:
     """rows/sec/chip of join+groupby over `rows`-per-side tables."""
     import jax
@@ -108,21 +136,7 @@ def _measure(rows: int) -> float:
     _log(f"rows={rows} join_count={m} out_cap={out_cap} algo={algo} "
          f"cached={from_cache}")
 
-    def make_pipeline(cap: int):
-        @jax.jit
-        def pipeline(cl, cnt_l, cr, cnt_r):
-            # key_grouped inner join emits equal keys adjacent, so the
-            # group-by is the sort-free boundary-scan pipeline kernel — one
-            # big sort in the whole program instead of two
-            joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
-                                              (0,), (0,), JoinType.INNER,
-                                              cap, algo, key_grouped=True)
-            gcols, g = groupby_mod.pipeline_groupby(
-                joined, jm, (0,), ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
-            return gcols[1].data, gcols[2].data, g, jm
-        return pipeline
-
-    pipeline = make_pipeline(out_cap)
+    pipeline = make_bench_pipeline(out_cap, algo)
     out = pipeline(cols_l, count, cols_r, count)
     jax.block_until_ready(out)  # compile + warm-up
     live = int(out[3])  # jm is the TRUE join count even when cap clipped
@@ -133,7 +147,7 @@ def _measure(rows: int) -> float:
         m = live
         if _cap_round(live) != out_cap:
             out_cap = _cap_round(live)
-            pipeline = make_pipeline(out_cap)
+            pipeline = make_bench_pipeline(out_cap, algo)
             out = pipeline(cols_l, count, cols_r, count)
             jax.block_until_ready(out)
             assert int(out[3]) == m

@@ -142,12 +142,13 @@ def join_row_count(cols_l: Tuple[Column, ...], count_l,
 
 @partial(jax.jit, static_argnames=("left_on", "right_on", "join_type",
                                    "out_capacity", "algorithm",
-                                   "key_grouped"))
+                                   "key_grouped", "project"))
 def join_gather(cols_l: Tuple[Column, ...], count_l,
                 cols_r: Tuple[Column, ...], count_r,
                 left_on: Tuple[int, ...], right_on: Tuple[int, ...],
                 join_type: JoinType, out_capacity: int,
-                algorithm: str = "sort", key_grouped: bool = False):
+                algorithm: str = "sort", key_grouped: bool = False,
+                project: "Tuple[int, ...] | None" = None):
     """Produce gathered output columns (left columns ++ right columns) with
     capacity ``out_capacity`` and the dynamic output row count.
 
@@ -225,6 +226,24 @@ def join_gather(cols_l: Tuple[Column, ...], count_l,
         lvalid = lvalid & ~in_tail
         out_count = total + m
 
-    out_l = tuple(c.take(lidx, valid_mask=lvalid) for c in cols_l)
-    out_r = tuple(c.take(ridx, valid_mask=rvalid) for c in cols_r)
-    return out_l + out_r, out_count
+    # projection pushdown: materialize ONLY the requested output columns
+    # (indices into left ++ right), in the requested order — a pruned
+    # column skips its whole out_capacity-sized gather+write (the
+    # reference prunes after materializing, join_utils.cpp
+    # build_final_table; here pruning happens inside the kernel)
+    n_l = len(cols_l)
+    n_out = n_l + len(cols_r)
+    if project is None:
+        project = tuple(range(n_out))
+    bad = [j for j in project if not 0 <= j < n_out]
+    if bad:
+        raise ValueError(f"project indices {bad} out of range for "
+                         f"{n_out} output columns (left {n_l} ++ right "
+                         f"{n_out - n_l}; negatives not supported)")
+    out = []
+    for j in project:
+        if j < n_l:
+            out.append(cols_l[j].take(lidx, valid_mask=lvalid))
+        else:
+            out.append(cols_r[j - n_l].take(ridx, valid_mask=rvalid))
+    return tuple(out), out_count

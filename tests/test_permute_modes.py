@@ -192,3 +192,69 @@ def test_permute_mode_default_by_backend(monkeypatch):
     assert compact.permute_mode() == "sort"
     monkeypatch.setenv("CYLON_TPU_PERMUTE", "scatter")
     assert compact.permute_mode() == "scatter"
+
+
+@pytest.mark.parametrize("kg,algo", [(True, "sort"), (True, "hash"),
+                                     (False, "sort"), (False, "hash")])
+def test_join_projection_key_grouped_and_hash(monkeypatch, kg, algo):
+    """The production configuration (key_grouped + project, both
+    algorithms): projected output must equal the full materialization's
+    selected columns row-for-row (key_grouped order is pinned)."""
+    rng = np.random.default_rng(11)
+    cap = 1 << 9
+    cols_l = (colmod.from_numpy(rng.integers(0, 60, cap).astype(np.int32)),
+              colmod.from_numpy(rng.random(cap).astype(np.float32)))
+    cols_r = (colmod.from_numpy(rng.integers(0, 60, cap).astype(np.int32)),
+              colmod.from_numpy(rng.random(cap).astype(np.float32)))
+    count = jnp.asarray(cap - 3, jnp.int32)
+
+    full, n = join_mod.join_gather(cols_l, count, cols_r, count,
+                                   (0,), (0,), JoinType.INNER, 1 << 12,
+                                   algo, key_grouped=kg)
+    proj, n2 = join_mod.join_gather(cols_l, count, cols_r, count,
+                                    (0,), (0,), JoinType.INNER, 1 << 12,
+                                    algo, key_grouped=kg,
+                                    project=(0, 1, 3))
+    n, n2 = int(n), int(n2)
+    assert n == n2
+    for want_idx, got in zip((0, 1, 3), proj):
+        np.testing.assert_array_equal(np.asarray(full[want_idx].data)[:n],
+                                      np.asarray(got.data)[:n])
+
+    with pytest.raises(ValueError, match="project"):
+        join_mod.join_gather(cols_l, count, cols_r, count, (0,), (0,),
+                             JoinType.INNER, 1 << 12, algo,
+                             project=(-1,))
+
+
+@pytest.mark.parametrize("jt", [JoinType.INNER, JoinType.FULL_OUTER])
+def test_join_projection_pushdown(monkeypatch, jt):
+    """project= must return exactly the selected columns of the full
+    materialization, in the requested order, in both permute modes."""
+    rng = np.random.default_rng(5)
+    cap = 1 << 9
+    cols_l = (colmod.from_numpy(rng.integers(0, 80, cap).astype(np.int32)),
+              colmod.from_numpy(rng.random(cap).astype(np.float32)))
+    cols_r = (colmod.from_numpy(rng.integers(0, 80, cap).astype(np.int32)),
+              colmod.from_numpy(rng.random(cap).astype(np.float32)))
+    count = jnp.asarray(cap - 7, jnp.int32)
+
+    def run():
+        full, n = join_mod.join_gather(cols_l, count, cols_r, count,
+                                       (0,), (0,), jt, 1 << 12, "sort")
+        proj, n2 = join_mod.join_gather(cols_l, count, cols_r, count,
+                                        (0,), (0,), jt, 1 << 12, "sort",
+                                        project=(3, 0, 1))
+        n, n2 = int(n), int(n2)
+        assert n == n2
+        for want_idx, got in zip((3, 0, 1), proj):
+            np.testing.assert_array_equal(
+                np.asarray(full[want_idx].data)[:n],
+                np.asarray(got.data)[:n])
+            np.testing.assert_array_equal(
+                np.asarray(full[want_idx].validity)[:n],
+                np.asarray(got.validity)[:n])
+        return n
+
+    a, b = _per_mode(monkeypatch, run)
+    assert a == b

@@ -45,15 +45,9 @@ def analyze(rows: int, algo: str = "sort") -> dict:
                                     (0,), (0,), JoinType.INNER, algo))
     out_cap = _cap_round(m)
 
-    def pipeline(cl, cnt_l, cr, cnt_r):
-        joined, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
-                                          (0,), (0,), JoinType.INNER, out_cap,
-                                          algo, key_grouped=True)
-        gcols, g = groupby_mod.pipeline_groupby(
-            joined, jm, (0,), ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
-        return gcols[1].data, gcols[2].data, g, jm
+    from bench import make_bench_pipeline  # THE bench program, shared
 
-    compiled = (jax.jit(pipeline)
+    compiled = (make_bench_pipeline(out_cap, algo)
                 .lower(cols_l, count, cols_r, count).compile())
     ma = compiled.memory_analysis()
     arg = int(ma.argument_size_in_bytes)

@@ -181,7 +181,7 @@ def make_full_join(cap):
     def full_join(cl, cr, cnt):
         return join_mod.join_gather(cl, cnt, cr, cnt, (0,), (0,),
                                     JoinType.INNER, cap, "sort",
-                                    key_grouped=True)
+                                    key_grouped=True, project=(0, 1, 3))
     return full_join
 
 full_join = make_full_join(out_cap)
@@ -199,19 +199,11 @@ joined = timed("join_gather total", full_join, cols_l, cols_r, count)
 @jax.jit
 def stage_gb(jcols, jm):
     return groupby_mod.pipeline_groupby(jcols, jm, (0,),
-                                        ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
+                                        ((1, AggOp.SUM), (2, AggOp.MEAN)), 0)
 
 timed("pipeline_groupby", stage_gb, joined[0], joined[1])
 
 # -- fused end-to-end ------------------------------------------------------
-@jax.jit
-def pipeline(cl, cnt_l, cr, cnt_r):
-    jcols, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r, (0,), (0,),
-                                     JoinType.INNER, out_cap, "sort",
-                                     key_grouped=True)
-    gcols, g = groupby_mod.pipeline_groupby(jcols, jm, (0,),
-                                            ((1, AggOp.SUM), (3, AggOp.MEAN)), 0)
-    return gcols[1].data, gcols[2].data, g, jm
-
+pipeline = _bench.make_bench_pipeline(out_cap, "sort")  # THE bench program
 timed("FULL fused pipeline", pipeline, cols_l, count, cols_r, count)
 print(f"done @ {ROWS} rows/side", flush=True)
