@@ -97,4 +97,22 @@ timed("associative_scan (sum,flag)", lambda x, f: jax.lax.associative_scan(
     (x, f)), c, a < (1 << 27), traffic_bytes=4 * B4)
 timed("elementwise a*b+c", lambda x, y: x * y + 1.0, c, c,
       traffic_bytes=3 * B4)
+
+# round-4b composite primitives (sort-realized permutation machinery) —
+# measured per-mode so the permute_mode default rests on this backend's
+# numbers, not the other's
+from cylon_tpu.ops import compact  # noqa: E402
+
+mask = a < jnp.uint32(1 << 29)
+for mode in ("scatter", "sort"):
+    os.environ["CYLON_TPU_PERMUTE"] = mode
+    timed(f"compact_indices ({mode})",
+          lambda m: compact.compact_indices(m)[0], mask,
+          traffic_bytes=2 * B4)
+    timed(f"inverse_permute 2-field ({mode})",
+          lambda p, x, y: compact.inverse_permute(p, x, y), perm,
+          a.astype(jnp.int32), b.astype(jnp.int32), traffic_bytes=6 * B4)
+os.environ.pop("CYLON_TPU_PERMUTE", None)
+timed("count_leq_dense", lambda v: compact.count_leq_dense(v, N),
+      jnp.sort(a.astype(jnp.int32) % N), traffic_bytes=4 * B4)
 print("done", flush=True)
