@@ -36,12 +36,14 @@ assert len(jax.devices()) == 8, jax.devices()
 # ~35% of the full tree, in backend_compile_and_load; each crashing test
 # passes in isolation).  A warm cache removes almost all in-process
 # compilation on repeat runs — both the time and the crash surface.
-# CYLON_TEST_NO_COMPILE_CACHE=1 disables for a cold-compile run.
+# Threshold 0: the crashing compiles are tiny (ms) — they must be
+# cacheable or reruns re-enter the crash. CYLON_TEST_NO_COMPILE_CACHE=1
+# disables for a cold-compile run.
 if os.environ.get("CYLON_TEST_NO_COMPILE_CACHE") != "1":
     jax.config.update("jax_compilation_cache_dir", os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
