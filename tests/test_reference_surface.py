@@ -1,0 +1,52 @@
+"""Automated API-surface parity against the reference's Python layer.
+
+Extracts every public method/dunder defined in the reference's
+python/pycylon/data/table.pyx and python/pycylon/frame.py and asserts the
+cylon_tpu Table / DataFrame expose the same names — the parity claim in
+COMPONENTS.md L6 as a machine check instead of a hand-grep.  Skipped when
+the reference tree is not present (e.g. an installed wheel elsewhere).
+"""
+import os
+import re
+
+import pytest
+
+REF_TABLE = "/root/reference/python/pycylon/data/table.pyx"
+REF_FRAME = "/root/reference/python/pycylon/frame.py"
+
+# Cython declaration tokens the `def X` grep over .pyx also matches —
+# C++ type names in cdef blocks and the Cython allocator — not API:
+CYTHON_DECL_NOISE = {
+    "CCSVWriteOptions", "CJoinConfig", "CSortOptions", "CStatus",
+    "__cinit__", "__init__", "bool", "class", "initialize", "shared_ptr",
+    "string", "vector", "void",
+}
+
+
+def _public_defs(path: str) -> set:
+    names = set(re.findall(r"def ([a-zA-Z_]+)", open(path).read()))
+    return {n for n in names
+            if not n.startswith("_")
+            or (n.startswith("__") and n.endswith("__"))}
+
+
+@pytest.mark.skipif(not os.path.exists(REF_TABLE),
+                    reason="reference tree not present")
+def test_table_surface_covers_reference():
+    from cylon_tpu import Table
+
+    want = _public_defs(REF_TABLE) - CYTHON_DECL_NOISE
+    missing = sorted(want - set(dir(Table)))
+    assert not missing, f"Table lacks reference methods: {missing}"
+    assert len(want) > 60  # the grep found the real surface, not a stub
+
+
+@pytest.mark.skipif(not os.path.exists(REF_FRAME),
+                    reason="reference tree not present")
+def test_frame_surface_covers_reference():
+    from cylon_tpu.frame import DataFrame
+
+    want = _public_defs(REF_FRAME) - CYTHON_DECL_NOISE
+    missing = sorted(want - set(dir(DataFrame)))
+    assert not missing, f"DataFrame lacks reference methods: {missing}"
+    assert len(want) > 25
