@@ -215,23 +215,41 @@ def _measure_chunked(rows: int, passes: int, emit=None):
     compute + D2H; the cold figure adds plan_seconds (exact-sizing pass).
     ``emit(value, cold)`` is called after EVERY completed sweep so a timeout
     during sweep 2 cannot discard sweep 1's finished measurement."""
+    from cylon_tpu import exec as exec_mod
     from cylon_tpu.exec import chunked_join_groupby
 
     algo = os.environ.get("CYLON_BENCH_ALGO", "sort")
     lk, lv, rk, rv = _make_data(rows)
     best = None
     cold = None  # first sweep's plan+run rows/sec: the honest one-shot cost
-    for sweep in range(2):  # full sweeps are expensive; plan/compile amortized
-        _, stats = chunked_join_groupby(lk, lv, rk, rv, passes, algo=algo)
-        _log(f"chunked rows={rows} passes={stats['passes']} "
-             f"plan={stats['plan_seconds']:.1f}s run={stats['run_seconds']:.1f}s "
-             f"total={stats['total_seconds']:.1f}s")
-        dt = stats["run_seconds"]
-        best = dt if best is None else min(best, dt)
-        if sweep == 0:
-            cold = (2 * rows) / stats["total_seconds"]
-        if emit is not None:
-            emit((2 * rows) / best, cold)
+
+    if emit is not None:
+        # per-pass provisional fragments: a tunnel drop or deadline mid-
+        # sweep still yields an honest partial (input rows ~ uniform per
+        # range pass; the fragment carries [done, total] so no consumer
+        # can mistake it for a finished sweep).  Completed-sweep emits
+        # below supersede these in the parent.
+        def _progress(done, n, _out_rows, secs):
+            if 0 < done < n and secs > 0:
+                emit((2 * rows) * (done / n) / secs, cold,
+                     partial=[done, n])
+
+        exec_mod.PASS_PROGRESS_HOOK = _progress
+    try:
+        for sweep in range(2):  # sweeps are expensive; plan/compile amortized
+            _, stats = chunked_join_groupby(lk, lv, rk, rv, passes, algo=algo)
+            _log(f"chunked rows={rows} passes={stats['passes']} "
+                 f"plan={stats['plan_seconds']:.1f}s "
+                 f"run={stats['run_seconds']:.1f}s "
+                 f"total={stats['total_seconds']:.1f}s")
+            dt = stats["run_seconds"]
+            best = dt if best is None else min(best, dt)
+            if sweep == 0:
+                cold = (2 * rows) / stats["total_seconds"]
+            if emit is not None:
+                emit((2 * rows) / best, cold)
+    finally:
+        exec_mod.PASS_PROGRESS_HOOK = None
     return (2 * rows) / best, cold
 
 
@@ -279,7 +297,8 @@ def _worker(backend: str, skip: int = 0) -> int:
         passes = 0
 
     def emit_fragment(value: float, rows: int,
-                      value_cold: float | None = None) -> None:
+                      value_cold: float | None = None,
+                      partial: "list | None" = None) -> None:
         from cylon_tpu import precision as _prec
         from cylon_tpu.ops import segments as _segs
 
@@ -301,6 +320,11 @@ def _worker(backend: str, skip: int = 0) -> int:
                 # plan+run throughput incl. the exact-sizing pass: the
                 # one-shot out-of-core cost (round-3 advice)
                 frag["value_cold"] = value_cold
+            if partial is not None:
+                # [completed, total] passes of an UNFINISHED sweep — an
+                # honest partial a tunnel drop cannot erase; superseded
+                # by the completed-sweep fragment that follows
+                frag["partial"] = partial
         print(json.dumps(frag), flush=True)
 
     sizes = (_tpu_rows() if backend == "tpu" else CPU_ROWS)[skip:]
@@ -309,7 +333,8 @@ def _worker(backend: str, skip: int = 0) -> int:
             if passes > 1:
                 value, cold = _measure_chunked(
                     rows, passes,
-                    emit=lambda v, c: emit_fragment(v, rows, c))
+                    emit=lambda v, c, partial=None: emit_fragment(
+                        v, rows, c, partial))
             else:
                 value, cold = _measure(rows), None
         except Exception as e:  # OOM / compile failure: step down
@@ -446,6 +471,8 @@ class _Bench:
             out["passes"] = r["passes"]
             if r.get("value_cold") is not None:
                 out["value_cold"] = round(r["value_cold"], 1)
+            if r.get("partial"):
+                out["partial"] = r["partial"]
         if source == "cache" and r.get("measured_at"):
             out["measured_at"] = r["measured_at"]
         # baseline at the same size if cached, else the largest cached size

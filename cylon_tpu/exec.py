@@ -445,6 +445,13 @@ def _null_mask(a: np.ndarray):
     return None
 
 
+# Optional per-pass progress callback: (passes_done, n_passes,
+# out_rows_so_far, run_seconds_so_far).  Set by measurement drivers (the
+# TPU bench) so a tunnel drop or deadline mid-sweep still yields an
+# honest partial throughput from the COMPLETED passes; None costs nothing.
+PASS_PROGRESS_HOOK = None
+
+
 def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0):
     """Shared streaming loop: compile on a zero-count chunk (same shapes,
     no duplicate host pass over the largest chunk), then double-buffer —
@@ -466,6 +473,9 @@ def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0):
         total += n
         frames.append(frame)
         del cur, fut
+        if PASS_PROGRESS_HOOK is not None:
+            PASS_PROGRESS_HOOK(p + 1, n_passes, total,
+                               time.perf_counter() - t_run0)
     del nxt
     return t_plan, t_run0, frames, total
 
@@ -677,6 +687,9 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
         total += n
         frames.append(frame)
         del cur, fut
+        if PASS_PROGRESS_HOOK is not None:
+            PASS_PROGRESS_HOOK(p + 1, n_passes, total,
+                               time.perf_counter() - t_run0)
     del nxt
     result = _concat_host(frames)
     stats = {"passes": n_passes, "mode": mode_used, "chunk_cap": max(cap_l, cap_r),
