@@ -1,17 +1,23 @@
 #!/bin/bash
-# Poll the axon tunnel; the moment it serves, run the measurement battery
-# once and exit.  Outages last hours (see PERF.md), so this is the way to
-# catch a window without burning attention on manual probes.
+# Poll the axon tunnel; EACH time it serves, run the measurement battery into
+# a fresh run_<timestamp> dir, then RESUME polling — outages last hours and
+# windows can be shorter than the battery, so one watcher must catch every
+# window of the session (a battery cut by a drop is rerun on recovery
+# without overwriting the earlier capture).
 # Usage: tools/tpu_watch.sh [out_dir] [poll_seconds]
 set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-/tmp/battery}
 POLL=${2:-600}
+mkdir -p "$OUT"
 while true; do
     if timeout 90 python bench.py --worker probe >/dev/null 2>&1; then
-        echo "[watch $(date +%H:%M:%S)] tunnel alive; firing battery"
-        exec tools/tpu_battery.sh "$OUT"
+        RUN="$OUT/run_$(date +%m%d_%H%M%S)"
+        echo "[watch $(date +%H:%M:%S)] tunnel alive; firing battery -> $RUN"
+        tools/tpu_battery.sh "$RUN"
+        echo "[watch $(date +%H:%M:%S)] battery done; resuming poll"
+    else
+        echo "[watch $(date +%H:%M:%S)] tunnel down; sleeping ${POLL}s"
     fi
-    echo "[watch $(date +%H:%M:%S)] tunnel down; sleeping ${POLL}s"
     sleep "$POLL"
 done
