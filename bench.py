@@ -112,9 +112,7 @@ def _measure(rows: int) -> float:
     import cylon_tpu  # noqa: F401  (enables x64; kernels narrow on TPU)
     from cylon_tpu import column as colmod
     from cylon_tpu.config import JoinType
-    from cylon_tpu.ops import groupby as groupby_mod
     from cylon_tpu.ops import join as join_mod
-    from cylon_tpu.ops.groupby import AggOp
     from cylon_tpu.table import _cap_round
 
     lk, lv, rk, rv = _make_data(rows)

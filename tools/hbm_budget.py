@@ -27,9 +27,7 @@ def analyze(rows: int, algo: str = "sort") -> dict:
     import cylon_tpu  # noqa: F401
     from cylon_tpu import column as colmod
     from cylon_tpu.config import JoinType
-    from cylon_tpu.ops import groupby as groupby_mod
     from cylon_tpu.ops import join as join_mod
-    from cylon_tpu.ops.groupby import AggOp
     from cylon_tpu.table import _cap_round
 
     rng = np.random.default_rng(1)
