@@ -1,0 +1,63 @@
+"""One-screen digest of a battery run directory.
+
+Usage: python tools/digest_battery.py [/tmp/battery_r4/run_XXXX ...]
+With no args, digests every run_* dir under /tmp/battery_r4 (plus the
+bare dir itself for pre-loop captures), newest last.
+"""
+import glob
+import json
+import os
+import sys
+
+
+def _bench_line(path: str):
+    try:
+        with open(path) as f:
+            txt = f.read().strip()
+        if not txt:
+            return None
+        return json.loads(txt.splitlines()[-1])
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def digest(d: str) -> None:
+    print(f"== {d}")
+    for name in sorted(glob.glob(os.path.join(d, "bench_*.json"))):
+        r = _bench_line(name)
+        if r is None:
+            print(f"  {os.path.basename(name):24s} (empty)")
+            continue
+        if "error" in r:
+            print(f"  {os.path.basename(name):24s} {r['error']}")
+            continue
+        extras = "".join(
+            f" {k}={r[k]}" for k in ("algo", "sort_mode", "segsum", "permute",
+                                     "passes", "partial", "device_kind")
+            if r.get(k) is not None)
+        print(f"  {os.path.basename(name):24s} {r.get('value', 0):>14,.0f} "
+              f"rows/s @ {r.get('rows_per_side', 0):>11,} rows/side "
+              f"[{r.get('source', '?')}]{extras}")
+    for name in ("microbench.txt", "profile_sort.txt", "profile.txt",
+                 "smoke.json", "baselines_full.json"):
+        path = os.path.join(d, name)
+        if os.path.exists(path) and os.path.getsize(path):
+            print(f"  -- {name}:")
+            with open(path) as f:
+                for line in f.read().splitlines()[:40]:
+                    print(f"     {line}")
+
+
+def main() -> int:
+    dirs = sys.argv[1:]
+    if not dirs:
+        base = "/tmp/battery_r4"
+        dirs = [base] + sorted(glob.glob(os.path.join(base, "run_*")))
+    for d in dirs:
+        if os.path.isdir(d):
+            digest(d)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
