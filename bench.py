@@ -48,6 +48,49 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+# Keys a legacy cache fragment may lack; absent means the fragment was
+# measured before the knob existed, i.e. under the OLD scatter defaults.
+# Single-sourced so seeding and artifact assembly can never disagree
+# about what an absent key means (round-4 advice finding 3).
+_LEGACY_DEFAULTS = {"segsum": "scatter", "permute": "scatter"}
+
+
+def _code_fingerprint() -> str:
+    """Content hash of the WHOLE package plus this file.  Cached TPU seeds
+    are keyed on it: a seed measured under different code is reported as
+    stale_code, so a stale number can never silently headline a round
+    (round-4 verdict item 4).  The package-wide net is deliberate — the
+    measured pipeline touches column/table/precision/context too, and a
+    false-stale (doc-only edit) only downgrades a fallback seed, while a
+    false-fresh would resurrect round 4's cache-echo headline.
+    Memoized: constant for the life of the process."""
+    global _FINGERPRINT
+    if _FINGERPRINT is not None:
+        return _FINGERPRINT
+    import hashlib
+
+    h = hashlib.sha256()
+    files = [os.path.abspath(__file__)]
+    for dirpath, _dirs, names in os.walk(os.path.join(_HERE, "cylon_tpu")):
+        files.extend(os.path.join(dirpath, n) for n in names
+                     if n.endswith(".py"))
+    for path in sorted(files):
+        try:
+            with open(path, "rb") as f:
+                # repo-relative names: the fingerprint must track content,
+                # not checkout location (a renamed or second clone of the
+                # identical tree is the same code)
+                h.update(os.path.relpath(path, _HERE).encode() + b"\0"
+                         + f.read() + b"\0")
+        except OSError:
+            continue
+    _FINGERPRINT = h.hexdigest()[:16]
+    return _FINGERPRINT
+
+
+_FINGERPRINT: "str | None" = None
+
+
 def _tpu_rows() -> list[int]:
     """TPU size ladder, overridable for battery climbs
     (CYLON_BENCH_ROWS=134217728,67108864)."""
@@ -445,6 +488,16 @@ class _Bench:
                 _log(f"cached tpu entry from {measured_at} exceeds max age "
                      f"{max_age_d:.0f}d; not seeding")
                 return
+        # Fingerprint gate (round-4 verdict item 4): a seed measured under
+        # a different hot path may still serve as the outage fallback, but
+        # it is marked stale_code so no driver or judge can mistake it for
+        # a number the current tree produced.
+        cur_fp = _code_fingerprint()
+        seed_fp = c.get("fingerprint")
+        if seed_fp != cur_fp:
+            c = dict(c, stale_code=True)
+            _log(f"cached tpu entry fingerprint {seed_fp or 'absent'} != "
+                 f"current {cur_fp}; seeding as stale_code")
         self.last = (c, "cache")
         self.result = self._artifact(c, source="cache")
         _log(f"provisional (cached tpu): {c['value']:.0f} rows/s "
@@ -461,10 +514,12 @@ class _Bench:
             "backend": r["backend"],
             "algo": r.get("algo", "sort"),
             "sort_mode": r.get("sort_mode", "cmp"),
-            "segsum": r.get("segsum", "scatter"),
-            "permute": r.get("permute", "scatter"),
+            "segsum": r.get("segsum", _LEGACY_DEFAULTS["segsum"]),
+            "permute": r.get("permute", _LEGACY_DEFAULTS["permute"]),
             "source": source,
         }
+        if r.get("stale_code"):
+            out["stale_code"] = True
         if r.get("passes"):
             out["passes"] = r["passes"]
             if r.get("value_cold") is not None:
@@ -493,18 +548,30 @@ class _Bench:
             self.last = (r, source)
             self.result = self._artifact(r, source)
         cur = self.cache.get("tpu")
+        cur_fp = _code_fingerprint()
+        # A seed from a DIFFERENT hot path never outranks a live number
+        # from the current one, whatever its value: the old behavior let a
+        # faster round-2 seed block the current tree's slower live result
+        # from becoming the seed, which is exactly the staleness the
+        # fingerprint exists to kill.
+        beats_cur = (cur is None or r["value"] >= cur["value"]
+                     or cur.get("fingerprint") != cur_fp)
         if r["backend"] in ("tpu", "axon") and r.get("algo", "sort") == "sort" \
-                and r.get("segsum", "prefix") == "prefix" \
+                and r.get("segsum", _LEGACY_DEFAULTS["segsum"]) == "prefix" \
                 and r.get("sort_mode", "cmp") == "cmp" \
-                and r.get("permute", "sort") == "sort" \
+                and r.get("permute", _LEGACY_DEFAULTS["permute"]) == "sort" \
                 and not r.get("passes") \
-                and (cur is None or r["value"] >= cur["value"]):
-            # the seed is the best default-config TPU number: an experiment
-            # (hash algo, prefix segsum, CYLON_TPU_PERMUTE=scatter) or a
-            # slower outsized run must not replace it as the provisional
-            # artifact for future rounds ("sort" is the TPU auto default,
-            # so an explicit =sort run is the same program as default)
-            self.cache["tpu"] = dict(r, measured_at=time.strftime("%Y-%m-%d"))
+                and beats_cur:
+            # the seed is the best default-config TPU number for the
+            # CURRENT hot path: an experiment (hash algo, scatter segsum,
+            # CYLON_TPU_PERMUTE=scatter) or a slower outsized run must not
+            # replace it as the provisional artifact for future rounds
+            # ("sort"/"prefix" are the TPU auto defaults, so explicit
+            # =sort/=prefix runs are the same program as default; a live
+            # fragment always carries both keys — emit_fragment sets them —
+            # so the legacy-default fallbacks only reject foreign records)
+            self.cache["tpu"] = dict(r, measured_at=time.strftime("%Y-%m-%d"),
+                                     fingerprint=cur_fp)
             self.save_cache()
 
     def rebuild(self) -> None:

@@ -55,7 +55,10 @@ def _mask_sort_perm(mask: jax.Array) -> jax.Array:
     cap = mask.shape[0]
     bits = index_bits(cap)
     if bits + 1 > 32:
-        iota = jnp.arange(cap, dtype=jnp.int32)
+        # >=2^31 rows: int32 positions would wrap negative — exactly the
+        # case this branch exists for — so carry the permutation in int64
+        # (x64 is enabled package-wide; round-4 advice finding 1)
+        iota = jnp.arange(cap, dtype=jnp.int64)
         _, perm = jax.lax.sort(
             (jnp.where(mask, jnp.uint32(0), jnp.uint32(1)), iota),
             num_keys=1, is_stable=True)
@@ -67,18 +70,27 @@ def _mask_sort_perm(mask: jax.Array) -> jax.Array:
     return (s & jnp.uint32((1 << bits) - 1)).astype(jnp.int32)
 
 
+def _idx_dtype(cap: int):
+    """Row-index dtype wide enough for ``cap`` rows: positions at or past
+    2^31 wrap negative in int32, so the >31-bit regime (reachable
+    internally, e.g. count_leq_dense's merged csum + out_capacity array)
+    carries indices in int64 (round-4 advice finding 1)."""
+    return jnp.int64 if cap > (1 << 31) - 1 else jnp.int32
+
+
 def compact_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """(idx, new_count): the first ``new_count`` entries of ``idx`` are the
     row indices where ``mask`` is True, in order; entries past new_count
-    are in-bounds filler that callers must mask.  new_count is an int32
-    scalar."""
-    new_count = jnp.sum(mask, dtype=jnp.int32)
+    are in-bounds filler that callers must mask.  new_count is a scalar
+    (int32 below 2^31 rows, int64 past it)."""
+    cap = mask.shape[0]
+    it = _idx_dtype(cap)
+    new_count = jnp.sum(mask, dtype=it)
     if permute_mode() == "sort":
         return _mask_sort_perm(mask), new_count
-    cap = mask.shape[0]
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    pos = jnp.cumsum(mask, dtype=jnp.int32) - 1
-    idx = jnp.zeros((cap,), jnp.int32).at[
+    iota = jnp.arange(cap, dtype=it)
+    pos = jnp.cumsum(mask, dtype=it) - 1
+    idx = jnp.zeros((cap,), it).at[
         jnp.where(mask, pos, cap)].set(iota, mode="drop")
     return idx, new_count
 
@@ -89,15 +101,16 @@ def partition_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
     Unlike ``compact_indices`` the tail is the real False rows, so ``perm``
     is a permutation of [0, n) usable wherever each row must appear exactly
     once (e.g. reordering a table without dropping rows)."""
-    nt = jnp.sum(mask, dtype=jnp.int32)
+    cap = mask.shape[0]
+    it = _idx_dtype(cap)
+    nt = jnp.sum(mask, dtype=it)
     if permute_mode() == "sort":
         return _mask_sort_perm(mask), nt
-    cap = mask.shape[0]
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    ct = jnp.cumsum(mask, dtype=jnp.int32)
+    iota = jnp.arange(cap, dtype=it)
+    ct = jnp.cumsum(mask, dtype=it)
     cf = iota + 1 - ct  # cumsum of ~mask without a second scan
     dest = jnp.where(mask, ct - 1, nt + cf - 1)
-    perm = jnp.zeros((cap,), jnp.int32).at[dest].set(iota)
+    perm = jnp.zeros((cap,), it).at[dest].set(iota)
     return perm, nt
 
 

@@ -13,6 +13,72 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 
+def _load_bench_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cache_seed_fingerprint_gate(tmp_path, monkeypatch):
+    """A cached TPU seed whose code fingerprint does not match the current
+    hot path must surface as stale_code in the artifact; a matching seed
+    must not (round-4 verdict item 4: a stale seed can never silently
+    headline a round)."""
+    bench = _load_bench_module()
+    cache = tmp_path / ".bench_cache.json"
+    monkeypatch.setattr(bench, "CACHE_PATH", str(cache))
+    seed = {"value": 1.0e6, "rows": 1 << 20, "backend": "tpu",
+            "algo": "sort", "sort_mode": "cmp", "segsum": "prefix",
+            "permute": "sort", "measured_at": time_today()}
+
+    cache.write_text(json.dumps({"tpu": dict(seed, fingerprint="feedbeef"),
+                                 "pandas": {}}))
+    b = bench._Bench(budget_s=1.0)
+    assert b.result is not None and b.result["source"] == "cache"
+    assert b.result.get("stale_code") is True
+
+    cache.write_text(json.dumps(
+        {"tpu": dict(seed, fingerprint=bench._code_fingerprint()),
+         "pandas": {}}))
+    b = bench._Bench(budget_s=1.0)
+    assert b.result is not None and b.result["source"] == "cache"
+    assert "stale_code" not in b.result
+
+
+def test_live_result_supersedes_foreign_fingerprint_seed(tmp_path,
+                                                         monkeypatch):
+    """A live default-config TPU result from the CURRENT tree must become
+    the cache seed even when a foreign-fingerprint seed has a higher
+    value (the round-4 failure: a faster round-2 seed blocked the current
+    tree's live number)."""
+    bench = _load_bench_module()
+    cache = tmp_path / ".bench_cache.json"
+    monkeypatch.setattr(bench, "CACHE_PATH", str(cache))
+    old = {"value": 9.9e6, "rows": 1 << 20, "backend": "tpu",
+           "algo": "sort", "sort_mode": "cmp", "segsum": "scatter",
+           "permute": "scatter", "measured_at": time_today(),
+           "fingerprint": "feedbeef"}
+    cache.write_text(json.dumps({"tpu": old, "pandas": {}}))
+    b = bench._Bench(budget_s=1.0)
+    live = {"value": 2.0e6, "rows": 1 << 20, "backend": "tpu",
+            "algo": "sort", "sort_mode": "cmp", "segsum": "prefix",
+            "permute": "sort"}
+    b.accept(live, source="live")
+    saved = json.loads(cache.read_text())["tpu"]
+    assert saved["value"] == 2.0e6
+    assert saved["fingerprint"] == bench._code_fingerprint()
+    assert b.result["source"] == "live" and "stale_code" not in b.result
+
+
+def time_today() -> str:
+    import time as _t
+
+    return _t.strftime("%Y-%m-%d")
+
+
 @pytest.mark.slow
 def test_bench_emits_one_valid_artifact_line():
     env = dict(os.environ)

@@ -121,6 +121,43 @@ def test_perm_by_target_wide_mesh_fallback(rng):
             assert (np.diff(idx) > 0).all(), "must be stable within target"
 
 
+def test_target_counts_wide_mesh_sort_mode(rng, monkeypatch):
+    """sort permute mode switches from the dense alphabet compare to the
+    sort + count_leq_dense derivation past world=32 (round-4 advice: the
+    O(cap*world) broadcast intermediate); every path must agree with the
+    scatter-mode segment_sum, including padding (== world) and the
+    out-of-range remap."""
+    import jax.numpy as jnp
+
+    from cylon_tpu.parallel import shuffle
+
+    n = 4096
+    for world in (8, 40, 100):
+        t = np.append(rng.integers(0, world, n - 7),
+                      [world] * 5 + [-3, world + 9]).astype(np.int32)
+        targets = jnp.asarray(t)
+        monkeypatch.setenv("CYLON_TPU_PERMUTE", "scatter")
+        ref = np.asarray(shuffle.target_counts(targets, world))
+        monkeypatch.setenv("CYLON_TPU_PERMUTE", "sort")
+        got = np.asarray(shuffle.target_counts(targets, world))
+        expected = np.bincount(t[(t >= 0) & (t < world)], minlength=world)
+        np.testing.assert_array_equal(ref, expected)
+        np.testing.assert_array_equal(got, expected)
+
+
+def test_compact_index_dtype_selection():
+    """Index dtype promotes to int64 only past 2^31 rows (round-4 advice:
+    the fallback the guard exists for must not wrap int32)."""
+    import jax.numpy as jnp
+
+    from cylon_tpu.ops import compact
+
+    assert compact._idx_dtype(1 << 20) == jnp.int32
+    assert compact._idx_dtype((1 << 31) - 1) == jnp.int32
+    assert compact._idx_dtype(1 << 31) == jnp.int64
+    assert compact._idx_dtype((1 << 31) + 7) == jnp.int64
+
+
 def test_lexsort_64bit_boundary(rng):
     """3 x i16 keys: pad(1) + 3*(validity+16) = 52 bits; cap 4096 gives
     idx_bits 12 -> exactly 64 (fast path ceiling), cap 8192 gives 65 ->
