@@ -1,0 +1,253 @@
+/* Perl XS glue over the cylon_tpu C ABI — the executed second-language
+ * consumer.
+ *
+ * Plays the role of the reference's Java binding
+ * (java/src/main/java/org/cylondata/cylon/Table.java:275-293 calling
+ * JNI -> table_api.hpp): a managed-runtime host whose interpreter loads
+ * this compiled glue via its native loader (DynaLoader, Perl's JNI
+ * counterpart) and drives the registry/builder/reader surface from
+ * script code.  Unlike the Panama-FFM JVM consumer (examples/
+ * jvm_consumer/, unexecutable here: the image ships no JDK and has no
+ * network egress), this host actually RUNS on this image —
+ * tests/test_native.py builds and executes it.
+ *
+ * Build (consumer.pl's header comment and the test do this):
+ *   gcc -shared -fPIC $(perl -MExtUtils::Embed -e ccopts) \
+ *       -I<repo>/cylon_tpu/native/include CylonTPU.c \
+ *       -L<libdir> -lcylon_tpu -o auto/CylonTPU/CylonTPU.so
+ *
+ * Conventions: byte buffers cross the boundary as Perl strings (pack'd
+ * binary); borrowed C pointers are COPIED into fresh Perl scalars before
+ * return, so script code can never hold a dangling registry view.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "cylon_tpu_c.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+static const char *arg_str(pTHX_ SV *sv) { return SvPV_nolen(sv); }
+
+/* data pointer of a Perl string arg, or NULL for undef */
+static const void *arg_buf(pTHX_ SV *sv) {
+  if (!SvOK(sv)) return NULL;
+  return (const void *)SvPV_nolen(sv);
+}
+
+XS(xs_builder_begin); /* prototypes quiet -Wmissing-prototypes */
+XS(xs_builder_begin) {
+  dXSARGS;
+  if (items != 1) croak("builder_begin(id)");
+  XSRETURN_IV(ct_builder_begin(arg_str(aTHX_ ST(0))));
+}
+
+XS(xs_builder_add_column);
+XS(xs_builder_add_column) {
+  dXSARGS;
+  if (items != 8)
+    croak("builder_add_column(id,name,dtype,width,rows,data,validity,lengths)");
+  XSRETURN_IV(ct_builder_add_column(
+      arg_str(aTHX_ ST(0)), arg_str(aTHX_ ST(1)), (int32_t)SvIV(ST(2)),
+      (int32_t)SvIV(ST(3)), (int64_t)SvIV(ST(4)), arg_buf(aTHX_ ST(5)),
+      (const uint8_t *)arg_buf(aTHX_ ST(6)),
+      (const int32_t *)arg_buf(aTHX_ ST(7))));
+}
+
+XS(xs_builder_finish);
+XS(xs_builder_finish) {
+  dXSARGS;
+  if (items != 1) croak("builder_finish(id)");
+  XSRETURN_IV(ct_builder_finish(arg_str(aTHX_ ST(0))));
+}
+
+XS(xs_registry_contains);
+XS(xs_registry_contains) {
+  dXSARGS;
+  if (items != 1) croak("registry_contains(id)");
+  XSRETURN_IV(ct_registry_contains(arg_str(aTHX_ ST(0))));
+}
+
+XS(xs_registry_remove);
+XS(xs_registry_remove) {
+  dXSARGS;
+  if (items != 1) croak("registry_remove(id)");
+  XSRETURN_IV(ct_registry_remove(arg_str(aTHX_ ST(0))));
+}
+
+XS(xs_registry_size);
+XS(xs_registry_size) {
+  dXSARGS;
+  if (items != 0) croak("registry_size()");
+  XSRETURN_IV(ct_registry_size());
+}
+
+XS(xs_registry_clear);
+XS(xs_registry_clear) {
+  dXSARGS;
+  if (items != 0) croak("registry_clear()");
+  ct_registry_clear();
+  XSRETURN_EMPTY;
+}
+
+XS(xs_registry_ids);
+XS(xs_registry_ids) {
+  dXSARGS;
+  if (items != 0) croak("registry_ids()");
+  int64_t need = ct_registry_ids(NULL, 0);
+  if (need < 0) XSRETURN_UNDEF;
+  {
+    SV *out = newSV((STRLEN)need + 1);
+    char *p = SvPVX(out);
+    ct_registry_ids(p, need + 1);
+    SvCUR_set(out, (STRLEN)need);
+    SvPOK_on(out);
+    ST(0) = sv_2mortal(out);
+    XSRETURN(1);
+  }
+}
+
+XS(xs_table_rows);
+XS(xs_table_rows) {
+  dXSARGS;
+  if (items != 1) croak("table_rows(id)");
+  XSRETURN_IV(ct_table_rows(arg_str(aTHX_ ST(0))));
+}
+
+XS(xs_table_ncols);
+XS(xs_table_ncols) {
+  dXSARGS;
+  if (items != 1) croak("table_ncols(id)");
+  XSRETURN_IV(ct_table_ncols(arg_str(aTHX_ ST(0))));
+}
+
+XS(xs_table_col_name);
+XS(xs_table_col_name) {
+  dXSARGS;
+  if (items != 2) croak("table_col_name(id, i)");
+  {
+    /* ct_table_col_name requires a real buffer (no NULL sizing call);
+     * column names longer than this are NUL-truncated per the ABI */
+    char buf[512];
+    int32_t need = ct_table_col_name(arg_str(aTHX_ ST(0)),
+                                     (int32_t)SvIV(ST(1)), buf, sizeof buf);
+    if (need < 0) XSRETURN_UNDEF;
+    ST(0) = sv_2mortal(newSVpv(buf, 0));
+    XSRETURN(1);
+  }
+}
+
+XS(xs_table_col_info);
+XS(xs_table_col_info) {
+  dXSARGS;
+  if (items != 2) croak("table_col_info(id, i)");
+  {
+    int32_t dtype, width, has_validity, has_lengths;
+    int64_t rows;
+    int32_t rc = ct_table_col_info(arg_str(aTHX_ ST(0)),
+                                   (int32_t)SvIV(ST(1)), &dtype, &width,
+                                   &rows, &has_validity, &has_lengths);
+    if (rc != 0) XSRETURN_EMPTY;
+    EXTEND(SP, 5);
+    ST(0) = sv_2mortal(newSViv(dtype));
+    ST(1) = sv_2mortal(newSViv(width));
+    ST(2) = sv_2mortal(newSViv((IV)rows));
+    ST(3) = sv_2mortal(newSViv(has_validity));
+    ST(4) = sv_2mortal(newSViv(has_lengths));
+    XSRETURN(5);
+  }
+}
+
+/* copy a borrowed column view into a fresh Perl string of n bytes */
+static void ret_copied(pTHX_ SV **st0, const void *src, STRLEN n) {
+  SV *out = newSV(n + 1);
+  memcpy(SvPVX(out), src, n);
+  SvCUR_set(out, n);
+  SvPOK_on(out);
+  *st0 = sv_2mortal(out);
+}
+
+XS(xs_table_col_data);
+XS(xs_table_col_data) {
+  dXSARGS;
+  if (items != 2) croak("table_col_data(id, i)");
+  {
+    const char *id = arg_str(aTHX_ ST(0));
+    int32_t i = (int32_t)SvIV(ST(1));
+    int32_t dtype, width, has_validity, has_lengths;
+    int64_t rows;
+    const void *p;
+    if (ct_table_col_info(id, i, &dtype, &width, &rows, &has_validity,
+                          &has_lengths) != 0)
+      XSRETURN_UNDEF;
+    p = ct_table_col_data(id, i);
+    if (!p) XSRETURN_UNDEF;
+    ret_copied(aTHX_ &ST(0), p, (STRLEN)(rows * width));
+    XSRETURN(1);
+  }
+}
+
+XS(xs_table_col_validity);
+XS(xs_table_col_validity) {
+  dXSARGS;
+  if (items != 2) croak("table_col_validity(id, i)");
+  {
+    const char *id = arg_str(aTHX_ ST(0));
+    int32_t i = (int32_t)SvIV(ST(1));
+    int32_t dtype, width, has_validity, has_lengths;
+    int64_t rows;
+    const uint8_t *p;
+    if (ct_table_col_info(id, i, &dtype, &width, &rows, &has_validity,
+                          &has_lengths) != 0)
+      XSRETURN_UNDEF;
+    p = ct_table_col_validity(id, i);
+    if (!p) XSRETURN_UNDEF;
+    ret_copied(aTHX_ &ST(0), p, (STRLEN)rows);
+    XSRETURN(1);
+  }
+}
+
+XS(xs_table_col_lengths);
+XS(xs_table_col_lengths) {
+  dXSARGS;
+  if (items != 2) croak("table_col_lengths(id, i)");
+  {
+    const char *id = arg_str(aTHX_ ST(0));
+    int32_t i = (int32_t)SvIV(ST(1));
+    int32_t dtype, width, has_validity, has_lengths;
+    int64_t rows;
+    const int32_t *p;
+    if (ct_table_col_info(id, i, &dtype, &width, &rows, &has_validity,
+                          &has_lengths) != 0)
+      XSRETURN_UNDEF;
+    p = ct_table_col_lengths(id, i);
+    if (!p) XSRETURN_UNDEF;
+    ret_copied(aTHX_ &ST(0), p, (STRLEN)(rows * 4));
+    XSRETURN(1);
+  }
+}
+
+XS(boot_CylonTPU); /* DynaLoader entry point */
+XS(boot_CylonTPU) {
+  dXSARGS;
+  PERL_UNUSED_VAR(items);
+  newXS("CylonTPU::builder_begin", xs_builder_begin, __FILE__);
+  newXS("CylonTPU::builder_add_column", xs_builder_add_column, __FILE__);
+  newXS("CylonTPU::builder_finish", xs_builder_finish, __FILE__);
+  newXS("CylonTPU::registry_contains", xs_registry_contains, __FILE__);
+  newXS("CylonTPU::registry_remove", xs_registry_remove, __FILE__);
+  newXS("CylonTPU::registry_size", xs_registry_size, __FILE__);
+  newXS("CylonTPU::registry_clear", xs_registry_clear, __FILE__);
+  newXS("CylonTPU::registry_ids", xs_registry_ids, __FILE__);
+  newXS("CylonTPU::table_rows", xs_table_rows, __FILE__);
+  newXS("CylonTPU::table_ncols", xs_table_ncols, __FILE__);
+  newXS("CylonTPU::table_col_name", xs_table_col_name, __FILE__);
+  newXS("CylonTPU::table_col_info", xs_table_col_info, __FILE__);
+  newXS("CylonTPU::table_col_data", xs_table_col_data, __FILE__);
+  newXS("CylonTPU::table_col_validity", xs_table_col_validity, __FILE__);
+  newXS("CylonTPU::table_col_lengths", xs_table_col_lengths, __FILE__);
+  XSRETURN_YES;
+}

@@ -1,0 +1,100 @@
+#!/usr/bin/perl
+# Perl consumer of the cylon_tpu native binding surface — the executed
+# second-language host (the reference's equivalent: Java driving
+# table_api through JNI, java/src/main/java/org/cylondata/cylon/
+# Table.java:275-293).  Mirrors examples/c_consumer/consumer.c check for
+# check, but from a managed runtime: the interpreter loads the XS glue
+# (CylonTPU.c) through DynaLoader and all driving logic lives here in
+# script code.
+#
+# Build+run (tests/test_native.py::test_perl_consumer_builds_and_reads):
+#   gcc -shared -fPIC $(perl -MExtUtils::Embed -e ccopts) \
+#       -I<repo>/cylon_tpu/native/include CylonTPU.c \
+#       -L<libdir> -lcylon_tpu -Wl,-rpath,<libdir> \
+#       -o <build>/auto/CylonTPU/CylonTPU.so
+#   perl -I<build> consumer.pl
+# Prints PASS lines and exits 0 on success.
+use strict;
+use warnings;
+
+package CylonTPU;
+use DynaLoader;
+our @ISA = ('DynaLoader');
+__PACKAGE__->bootstrap;
+
+package main;
+
+my $failures = 0;
+
+sub check {
+    my ($ok, $msg) = @_;
+    if ($ok) { print "PASS: $msg\n"; }
+    else     { print STDERR "FAIL: $msg\n"; $failures++; }
+}
+
+# dtype codes from cylon_tpu.dtypes.Type (opaque to the registry; must
+# only agree with the reading side)
+my ($DT_INT64, $DT_DOUBLE, $DT_STRING) = (8, 11, 12);
+
+my $ids   = pack("q<4", 10, 20, 30, 40);
+my $vals  = pack("d<4", 1.5, 2.5, 3.5, 4.5);
+my $valid = pack("C4", 1, 1, 0, 1);
+# strings as a padded byte matrix (width 4) + per-row lengths — the same
+# layout cylon_tpu Columns use on device
+my $names = "ab\0\0" . "c\0\0\0" . "long" . "x\0\0\0";
+my $lens  = pack("l<4", 2, 1, 4, 1);
+
+check(CylonTPU::builder_begin("orders") == 0, "builder begin");
+check(CylonTPU::builder_begin("orders") == -1, "double begin rejected");
+check(CylonTPU::builder_add_column("orders", "id", $DT_INT64, 8, 4, $ids,
+                                   undef, undef) == 0, "add int64 column");
+check(CylonTPU::builder_add_column("orders", "v", $DT_DOUBLE, 8, 4, $vals,
+                                   $valid, undef) == 0,
+      "add double column with validity");
+check(CylonTPU::builder_add_column("orders", "s", $DT_STRING, 4, 4, $names,
+                                   undef, $lens) == 0, "add string column");
+check(CylonTPU::builder_add_column("orders", "bad", $DT_INT64, 8, 7, $ids,
+                                   undef, undef) == -2,
+      "row-count mismatch rejected");
+check(CylonTPU::registry_contains("orders") == 0, "not visible before finish");
+check(CylonTPU::builder_finish("orders") == 0, "builder finish");
+check(CylonTPU::registry_contains("orders") == 1, "visible after finish");
+
+check(CylonTPU::table_rows("orders") == 4, "row count");
+check(CylonTPU::table_ncols("orders") == 3, "column count");
+check(CylonTPU::table_rows("nope") == -1, "unknown id -> -1");
+
+check((CylonTPU::table_col_name("orders", 2) // "") eq "s", "column name");
+
+my ($dtype, $width, $rows, $has_validity, $has_lengths) =
+    CylonTPU::table_col_info("orders", 1);
+check(defined $dtype && $dtype == $DT_DOUBLE && $width == 8 && $rows == 4
+          && $has_validity == 1 && $has_lengths == 0, "column info");
+
+my @rid = unpack("q<4", CylonTPU::table_col_data("orders", 0));
+check($rid[0] == 10 && $rid[3] == 40, "int64 data round-trip");
+my @rv = unpack("d<4", CylonTPU::table_col_data("orders", 1));
+check($rv[1] == 2.5, "double data round-trip");
+my @rvd = unpack("C4", CylonTPU::table_col_validity("orders", 1));
+check($rvd[2] == 0 && $rvd[3] == 1, "validity round-trip");
+check(!defined CylonTPU::table_col_validity("orders", 0),
+      "absent validity undef");
+my @rl = unpack("l<4", CylonTPU::table_col_lengths("orders", 2));
+my $rs = CylonTPU::table_col_data("orders", 2);
+check($rl[2] == 4 && substr($rs, 2 * 4, 4) eq "long",
+      "string matrix + lengths round-trip");
+
+check(CylonTPU::builder_begin("t2") == 0
+          && CylonTPU::builder_finish("t2") == 0, "second table");
+check(CylonTPU::registry_size() == 2, "registry size");
+check((CylonTPU::registry_ids() // "") eq "orders\nt2",
+      "registry ids enumeration");
+
+check(CylonTPU::registry_remove("orders") == 0
+          && CylonTPU::registry_contains("orders") == 0, "remove");
+CylonTPU::registry_clear();
+check(CylonTPU::registry_size() == 0, "clear");
+
+if ($failures) { print STDERR "Perl consumer: $failures FAILURES\n"; exit 1; }
+print "Perl consumer: ALL PASS\n";
+exit 0;

@@ -274,6 +274,50 @@ def test_c_consumer_builds_and_reads(tmp_path):
     assert "ALL PASS" in run_proc.stdout
 
 
+def test_perl_consumer_builds_and_reads(tmp_path):
+    """A managed-runtime host (Perl 5) drives the registry + builder
+    through the C ABI via compiled XS glue loaded by DynaLoader
+    (examples/perl_consumer) — the EXECUTED second-language consumer on
+    this image, structurally the reference's Java path (Table.java:
+    275-293 -> JNI shim -> table_api.hpp): interpreter -> native loader
+    -> glue -> C ABI, with all driving logic in script code.  The JVM
+    consumer below is the letter-complete Java counterpart; it skips
+    here because the image ships no JDK and has no network egress."""
+    import shutil
+    import subprocess
+
+    perl = shutil.which("perl")
+    if not perl:
+        pytest.skip("no perl on this image")
+    from cylon_tpu.native import build as native_build
+
+    lib = native_build.build()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    srcdir = os.path.join(root, "examples", "perl_consumer")
+    ccopts = subprocess.run(
+        [perl, "-MExtUtils::Embed", "-e", "ccopts"],
+        capture_output=True, text=True)
+    if ccopts.returncode != 0:
+        pytest.skip("perl without ExtUtils::Embed (no CORE headers)")
+    sodir = tmp_path / "auto" / "CylonTPU"
+    sodir.mkdir(parents=True)
+    cc = os.environ.get("CC", "gcc")
+    inc = os.path.join(root, "cylon_tpu", "native", "include")
+    compile_proc = subprocess.run(
+        [cc, "-shared", "-fPIC", *ccopts.stdout.split(),
+         os.path.join(srcdir, "CylonTPU.c"), f"-I{inc}",
+         f"-L{os.path.dirname(lib)}", "-lcylon_tpu",
+         f"-Wl,-rpath,{os.path.dirname(lib)}",
+         "-o", str(sodir / "CylonTPU.so")],
+        capture_output=True, text=True)
+    assert compile_proc.returncode == 0, compile_proc.stderr
+    run_proc = subprocess.run(
+        [perl, f"-I{tmp_path}", os.path.join(srcdir, "consumer.pl")],
+        capture_output=True, text=True, timeout=60)
+    assert run_proc.returncode == 0, run_proc.stdout + run_proc.stderr
+    assert "ALL PASS" in run_proc.stdout
+
+
 def test_jvm_consumer_builds_and_reads(tmp_path):
     """A JVM host drives the registry + builder through the C ABI via
     Panama FFM (examples/jvm_consumer) — the letter-complete counterpart
