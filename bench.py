@@ -344,9 +344,8 @@ def _worker(backend: str, skip: int = 0) -> int:
         from cylon_tpu.ops import segments as _segs
 
         # report the EFFECTIVE reduction path, not the env request: the
-        # prefix scan only engages under narrow mode with the exact knob
-        segsum = ("prefix" if _segs.prefix_reductions_enabled()
-                  and _prec.narrow() else "scatter")
+        # scan paths only engage under narrow mode with the exact knob
+        segsum = _segs.effective_mode() if _prec.narrow() else "scatter"
         from cylon_tpu.ops import compact as _compact
 
         frag = {"value": value, "rows": rows, "backend": plat,

@@ -103,12 +103,15 @@ _SEGSUM_MODE: "str | None" = None  # None = read CYLON_TPU_SEGSUM
 
 
 def set_segsum(mode: "str | None") -> None:
-    """Force ``"prefix"`` or ``"scatter"`` segment reductions (None = env).
+    """Force ``"prefix"``, ``"pallas"`` or ``"scatter"`` segment reductions
+    (None = env).  ``pallas`` is prefix semantics through the two-sweep
+    Pallas kernel (ops/pallas_scan.py) instead of lax.associative_scan.
     Clears jit caches like precision.set_accumulation — the knob is read
     at trace time, so cached kernels would otherwise keep the old path."""
     global _SEGSUM_MODE
-    if mode not in (None, "prefix", "scatter"):
-        raise ValueError(f"segsum mode must be prefix/scatter, got {mode}")
+    if mode not in (None, "prefix", "pallas", "scatter"):
+        raise ValueError(
+            f"segsum mode must be prefix/pallas/scatter, got {mode}")
     if mode != _SEGSUM_MODE:
         jax.clear_caches()
     _SEGSUM_MODE = mode
@@ -128,13 +131,35 @@ def prefix_reductions_enabled() -> bool:
     time: set it before the first jitted compute or use set_segsum,
     which clears the jit caches."""
     if _SEGSUM_MODE is not None:
-        return _SEGSUM_MODE == "prefix"
+        return _SEGSUM_MODE in ("prefix", "pallas")
     import os
 
     mode = os.environ.get("CYLON_TPU_SEGSUM")
-    if mode in ("prefix", "scatter"):
-        return mode == "prefix"
+    if mode in ("prefix", "pallas", "scatter"):
+        return mode != "scatter"
     return jax.default_backend() in ("tpu", "axon")
+
+
+def effective_mode() -> str:
+    """The segment-reduction path trace-time state selects:
+    ``"pallas"`` | ``"prefix"`` | ``"scatter"`` (public accessor — bench
+    reporting keys on it)."""
+    if not prefix_reductions_enabled():
+        return "scatter"
+    return "pallas" if _pallas_scan_selected() else "prefix"
+
+
+def _pallas_scan_selected() -> bool:
+    """Whether the scan-free-of-associative_scan Pallas kernel backs
+    segmented_reduce_sorted (CYLON_TPU_SEGSUM=pallas / set_segsum).  Not
+    a default anywhere yet: the kernel's ~2-sweep HBM traffic vs the
+    scan's ~log2(n) materialized passes is a theoretical win awaiting
+    the hardware A/B (battery step; keep-or-kill like radix)."""
+    if _SEGSUM_MODE is not None:
+        return _SEGSUM_MODE == "pallas"
+    import os
+
+    return os.environ.get("CYLON_TPU_SEGSUM") == "pallas"
 
 
 def segmented_reduce_sorted(x: jax.Array, new_group: jax.Array,
@@ -151,6 +176,12 @@ def segmented_reduce_sorted(x: jax.Array, new_group: jax.Array,
     ``jax.ops.segment_*`` with ``num_segments = len(x)``); ids past the
     number of segments read the clipped last row (callers mask by group
     liveness, as they already do for the scatter path)."""
+    if _pallas_scan_selected() and x.dtype.itemsize == 4:
+        from . import pallas_scan
+
+        run_val = pallas_scan.segmented_scan(x, new_group, op)
+        return jnp.take(run_val, end - 1, mode="clip")
+
     fns = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
     fn = fns[op]
 

@@ -115,4 +115,13 @@ for mode in ("scatter", "sort"):
 os.environ.pop("CYLON_TPU_PERMUTE", None)
 timed("count_leq_dense", lambda v: compact.count_leq_dense(v, N),
       jnp.sort(a.astype(jnp.int32) % N), traffic_bytes=4 * B4)
+
+# the round-5 bet: two-sweep Pallas segmented scan vs the log-pass
+# associative_scan above (same combine, same data) — keep-or-kill A/B
+from cylon_tpu.ops import pallas_scan  # noqa: E402
+
+flags = a < (1 << 27)
+timed("pallas segmented_scan (sum,flag)",
+      lambda x, f: pallas_scan.segmented_scan(x, f, "sum"), c, flags,
+      traffic_bytes=6 * B4)
 print("done", flush=True)

@@ -1,0 +1,103 @@
+// Apples-to-apples driver for the patched reference build: the SAME
+// pipeline shape as /root/repo/bench.py — int32 keys uniform in [0, rows)
+// (~1:1 join), float32 values, inner join on the key, then groupby(key){
+// sum(a), mean(b)} — timed end to end, rows/sec = 2*rows/dt.
+// Usage: bench_join_groupby <rows_per_rank> [algo=hash|sort] [reps=3]
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <memory>
+#include <random>
+#include <string>
+#include <vector>
+
+#include <arrow/api.h>
+
+#include <ctx/cylon_context.hpp>
+#include <groupby/groupby.hpp>
+#include <join/join_config.hpp>
+#include <net/mpi/mpi_communicator.hpp>
+#include <table.hpp>
+
+using cylon::Table;
+
+static std::shared_ptr<arrow::Table> make_table(int64_t rows, uint64_t seed) {
+  std::mt19937_64 rng(seed);
+  std::uniform_int_distribution<int32_t> kd(0, (int32_t)rows - 1);
+  std::uniform_real_distribution<float> vd(0.f, 1.f);
+  arrow::Int32Builder kb;
+  arrow::FloatBuilder vb;
+  (void)kb.Reserve(rows);
+  (void)vb.Reserve(rows);
+  for (int64_t i = 0; i < rows; ++i) {
+    kb.UnsafeAppend(kd(rng));
+    vb.UnsafeAppend(vd(rng));
+  }
+  std::shared_ptr<arrow::Array> ka, va;
+  (void)kb.Finish(&ka);
+  (void)vb.Finish(&va);
+  auto schema = arrow::schema({arrow::field("k", arrow::int32()),
+                               arrow::field("v", arrow::float32())});
+  return arrow::Table::Make(schema, {ka, va});
+}
+
+int main(int argc, char **argv) {
+  int64_t rows = argc > 1 ? atoll(argv[1]) : (1 << 22);
+  std::string algo = argc > 2 ? argv[2] : "hash";
+  int reps = argc > 3 ? atoi(argv[3]) : 3;
+
+  auto mpi_config = std::make_shared<cylon::net::MPIConfig>();
+  auto ctx = cylon::CylonContext::InitDistributed(
+      std::static_pointer_cast<cylon::net::CommConfig>(mpi_config));
+  int rank = ctx->GetRank(), world = ctx->GetWorldSize();
+
+  auto at1 = make_table(rows, 12345 + rank);
+  auto at2 = make_table(rows, 54321 + rank);
+  std::shared_ptr<Table> t1, t2;
+  if (!Table::FromArrowTable(ctx, at1, t1).is_ok()) return 1;
+  if (!Table::FromArrowTable(ctx, at2, t2).is_ok()) return 1;
+
+  auto jc = algo == "sort"
+                ? cylon::join::config::JoinConfig::InnerJoin(
+                      0, 0, cylon::join::config::JoinAlgorithm::SORT)
+                : cylon::join::config::JoinConfig::InnerJoin(
+                      0, 0, cylon::join::config::JoinAlgorithm::HASH);
+
+  double best = 1e30;
+  int64_t out_rows = 0, g_rows = 0;
+  for (int r = 0; r < reps; ++r) {
+    ctx->GetCommunicator()->Barrier();
+    auto t0 = std::chrono::high_resolution_clock::now();
+    std::shared_ptr<Table> joined, grouped;
+    if (!cylon::DistributedJoin(t1, t2, jc, joined).is_ok()) {
+      fprintf(stderr, "join failed\n");
+      return 1;
+    }
+    if (!cylon::DistributedHashGroupBy(
+             joined, 0, {1, 3},
+             {cylon::compute::AggregationOpId::SUM,
+              cylon::compute::AggregationOpId::MEAN},
+             grouped)
+             .is_ok()) {
+      fprintf(stderr, "groupby failed\n");
+      return 1;
+    }
+    ctx->GetCommunicator()->Barrier();
+    auto t1c = std::chrono::high_resolution_clock::now();
+    double dt = std::chrono::duration<double>(t1c - t0).count();
+    if (dt < best) best = dt;
+    out_rows = joined->Rows();
+    g_rows = grouped->Rows();
+  }
+  if (rank == 0) {
+    printf(
+        "{\"driver\": \"reference-cylon\", \"algo\": \"%s\", \"np\": %d, "
+        "\"rows_per_rank\": %lld, \"join_rows_r0\": %lld, "
+        "\"group_rows_r0\": %lld, \"best_seconds\": %.4f, "
+        "\"rows_per_sec_total\": %.1f}\n",
+        algo.c_str(), world, (long long)rows, (long long)out_rows,
+        (long long)g_rows, best, (2.0 * rows * world) / best);
+  }
+  ctx->Finalize();
+  return 0;
+}

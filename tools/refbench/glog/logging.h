@@ -1,0 +1,54 @@
+// Minimal glog-compatible logging surface so the reference builds without
+// glog (zero-egress image). Only what cylon 0.2.0 uses: LOG(sev) streams,
+// CHECK macros, InitGoogleLogging.
+#ifndef GLOG_SHIM_LOGGING_H_
+#define GLOG_SHIM_LOGGING_H_
+#include <cstdlib>
+#include <iostream>
+#include <sstream>
+
+namespace google {
+inline void InitGoogleLogging(const char * = nullptr) {}
+inline void ShutdownGoogleLogging() {}
+}  // namespace google
+
+namespace glog_shim {
+class LogMessage {
+ public:
+  LogMessage(const char *sev, bool fatal) : fatal_(fatal) { ss_ << "[" << sev << "] "; }
+  ~LogMessage() {
+    ss_ << "\n";
+    std::cerr << ss_.str();
+    if (fatal_) std::abort();
+  }
+  std::ostream &stream() { return ss_; }
+
+ private:
+  std::ostringstream ss_;
+  bool fatal_;
+};
+// Swallows the stream when the condition is healthy.
+class NullStream {
+ public:
+  template <typename T> NullStream &operator<<(const T &) { return *this; }
+};
+}  // namespace glog_shim
+
+#define LOG(severity) LOG_##severity.stream()
+#define LOG_INFO ::glog_shim::LogMessage("I", false)
+#define LOG_WARNING ::glog_shim::LogMessage("W", false)
+#define LOG_ERROR ::glog_shim::LogMessage("E", false)
+#define LOG_FATAL ::glog_shim::LogMessage("F", true)
+
+#define CHECK(cond) \
+  if (cond) ; else ::glog_shim::LogMessage("F", true).stream() << "CHECK failed: " #cond " "
+#define CHECK_EQ(a, b) CHECK((a) == (b))
+#define CHECK_NE(a, b) CHECK((a) != (b))
+#define CHECK_LT(a, b) CHECK((a) < (b))
+#define CHECK_LE(a, b) CHECK((a) <= (b))
+#define CHECK_GT(a, b) CHECK((a) > (b))
+#define CHECK_GE(a, b) CHECK((a) >= (b))
+#define DCHECK(cond) CHECK(cond)
+#define DCHECK_EQ(a, b) CHECK_EQ(a, b)
+
+#endif  // GLOG_SHIM_LOGGING_H_

@@ -1,0 +1,82 @@
+// Minimal MPI surface for building/running the reference on a no-MPI image.
+// Implements exactly the calls cylon 0.2.0 uses (inventory: Init,
+// Initialized, Finalize, Comm_rank/size, Barrier, Isend/Irecv/Test/Wait/
+// Get_count, Allreduce) for multi-process runs over local TCP sockets,
+// rendezvous via SHIMMPI_* environment variables set by shim_mpirun.
+// Handles are opaque pointer types (cylon compares them to nullptr, like
+// OpenMPI's).
+#ifndef SHIM_MPI_H_
+#define SHIM_MPI_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct shimmpi_comm_s *MPI_Comm;
+typedef struct shimmpi_dtype_s *MPI_Datatype;
+typedef struct shimmpi_op_s *MPI_Op;
+
+#define MPI_COMM_WORLD ((MPI_Comm)1)
+
+#define MPI_BYTE ((MPI_Datatype)1)
+#define MPI_INT ((MPI_Datatype)2)
+#define MPI_UINT8_T ((MPI_Datatype)3)
+#define MPI_INT8_T ((MPI_Datatype)4)
+#define MPI_UINT16_T ((MPI_Datatype)5)
+#define MPI_INT16_T ((MPI_Datatype)6)
+#define MPI_UINT32_T ((MPI_Datatype)7)
+#define MPI_INT32_T ((MPI_Datatype)8)
+#define MPI_UINT64_T ((MPI_Datatype)9)
+#define MPI_INT64_T ((MPI_Datatype)10)
+#define MPI_FLOAT ((MPI_Datatype)11)
+#define MPI_DOUBLE ((MPI_Datatype)12)
+#define MPI_CXX_BOOL ((MPI_Datatype)13)
+#define MPI_LONG ((MPI_Datatype)14)
+#define MPI_UNSIGNED ((MPI_Datatype)15)
+#define MPI_UNSIGNED_LONG ((MPI_Datatype)16)
+
+#define MPI_SUM ((MPI_Op)1)
+#define MPI_MIN ((MPI_Op)2)
+#define MPI_MAX ((MPI_Op)3)
+#define MPI_PROD ((MPI_Op)4)
+
+#define MPI_SUCCESS 0
+#define MPI_ERR_OTHER 1
+
+typedef struct MPI_Status {
+  int MPI_SOURCE;
+  int MPI_TAG;
+  int MPI_ERROR;
+  int _count; /* bytes received (shim-internal, read via MPI_Get_count) */
+} MPI_Status;
+
+#define MPI_STATUS_IGNORE ((MPI_Status *)0)
+#define MPI_STATUSES_IGNORE ((MPI_Status *)0)
+
+/* Request handle: index+1 into the shim's request table (0 = null). */
+typedef int MPI_Request;
+#define MPI_REQUEST_NULL 0
+
+int MPI_Init(int *argc, char ***argv);
+int MPI_Initialized(int *flag);
+int MPI_Finalize(void);
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
+              int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status);
+int MPI_Wait(MPI_Request *request, MPI_Status *status);
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
+                  int *count);
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SHIM_MPI_H_ */

@@ -1,0 +1,414 @@
+// TCP-socket MPI shim backing shim/mpi.h — just enough MPI to run the
+// reference's benches multi-process on an image with no MPI installation.
+//
+// Topology: full mesh over localhost TCP. Rank r listens on
+// SHIMMPI_BASE_PORT + r; rank j > i connects to rank i. Frames are
+// [tag:i32][len:i32][payload]. Sends are eagerly buffered (the shim
+// memcpys into a per-peer outbox, so send requests complete immediately,
+// like MPI's eager protocol for small/medium messages); progress happens
+// inside Test/Wait/Barrier/Allreduce via nonblocking socket IO.
+//
+// NOT a general MPI: COMM_WORLD only, no ANY_SOURCE/ANY_TAG, ordering
+// guaranteed per (source, tag) — exactly cylon 0.2.0's usage.
+#include "mpi.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Frame {
+  int tag;
+  std::vector<uint8_t> data;
+};
+
+struct Peer {
+  int fd = -1;
+  std::deque<std::vector<uint8_t>> outbox;  // framed bytes pending write
+  size_t out_off = 0;                       // offset into outbox.front()
+  std::vector<uint8_t> inbuf;               // partial incoming bytes
+  std::deque<Frame> inbox;                  // complete frames, FIFO
+};
+
+struct RecvReq {
+  void *buf;
+  int max_bytes;
+  int source;
+  int tag;
+  bool done = false;
+  int got_bytes = 0;
+  bool active = false;
+  bool is_send = false;
+};
+
+int g_rank = -1, g_size = 0;
+bool g_init = false;
+std::vector<Peer> g_peers;
+std::vector<RecvReq> g_reqs;
+int g_listen_fd = -1;
+
+// Reserved internal tag space (user tags are small non-negative ints).
+constexpr int kTagBarrier = 0x7ffffff0;
+constexpr int kTagReduce = 0x7ffffff1;
+constexpr int kTagBcast = 0x7ffffff2;
+
+void die(const char *msg) {
+  fprintf(stderr, "[shimmpi %d] fatal: %s (errno %d %s)\n", g_rank, msg,
+          errno, strerror(errno));
+  abort();
+}
+
+void set_nonblock(int fd, bool nb) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, nb ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK));
+}
+
+int dtype_size(MPI_Datatype d) {
+  switch ((intptr_t)d) {
+    case 1: case 3: case 4: case 13: return 1;
+    case 5: case 6: return 2;
+    case 2: case 7: case 8: case 11: case 15: return 4;
+    default: return 8;
+  }
+}
+
+// Drain readable bytes from peer p into complete frames.
+void pump_read(int p) {
+  Peer &pe = g_peers[p];
+  if (pe.fd < 0) return;
+  uint8_t tmp[1 << 16];
+  while (true) {
+    ssize_t n = recv(pe.fd, tmp, sizeof(tmp), 0);
+    if (n > 0) {
+      pe.inbuf.insert(pe.inbuf.end(), tmp, tmp + n);
+    } else if (n == 0) {
+      break;  // peer closed; leftover frames already queued
+    } else {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      die("recv");
+    }
+  }
+  // peel complete frames
+  size_t off = 0;
+  while (pe.inbuf.size() - off >= 8) {
+    int32_t tag, len;
+    memcpy(&tag, pe.inbuf.data() + off, 4);
+    memcpy(&len, pe.inbuf.data() + off + 4, 4);
+    if (pe.inbuf.size() - off - 8 < (size_t)len) break;
+    Frame f;
+    f.tag = tag;
+    f.data.assign(pe.inbuf.begin() + off + 8,
+                  pe.inbuf.begin() + off + 8 + len);
+    pe.inbox.push_back(std::move(f));
+    off += 8 + len;
+  }
+  if (off) pe.inbuf.erase(pe.inbuf.begin(), pe.inbuf.begin() + off);
+}
+
+// Write as much pending outbox as the socket accepts.
+void pump_write(int p) {
+  Peer &pe = g_peers[p];
+  while (pe.fd >= 0 && !pe.outbox.empty()) {
+    auto &front = pe.outbox.front();
+    ssize_t n = send(pe.fd, front.data() + pe.out_off,
+                     front.size() - pe.out_off, MSG_NOSIGNAL);
+    if (n > 0) {
+      pe.out_off += n;
+      if (pe.out_off == front.size()) {
+        pe.outbox.pop_front();
+        pe.out_off = 0;
+      }
+    } else {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      die("send");
+    }
+  }
+}
+
+void progress() {
+  for (int p = 0; p < g_size; ++p) {
+    if (p == g_rank) continue;
+    pump_write(p);
+    pump_read(p);
+  }
+}
+
+void enqueue_send(int dest, int tag, const void *buf, int bytes) {
+  if (dest == g_rank) {
+    Frame f;
+    f.tag = tag;
+    f.data.assign((const uint8_t *)buf, (const uint8_t *)buf + bytes);
+    g_peers[g_rank].inbox.push_back(std::move(f));
+    return;
+  }
+  std::vector<uint8_t> framed(8 + bytes);
+  int32_t t = tag, l = bytes;
+  memcpy(framed.data(), &t, 4);
+  memcpy(framed.data() + 4, &l, 4);
+  memcpy(framed.data() + 8, buf, bytes);
+  g_peers[dest].outbox.push_back(std::move(framed));
+  pump_write(dest);
+}
+
+// Blocking receive of one frame with `tag` from `source` (internal use).
+Frame recv_frame_blocking(int source, int tag) {
+  Peer &pe = g_peers[source];
+  while (true) {
+    for (auto it = pe.inbox.begin(); it != pe.inbox.end(); ++it) {
+      if (it->tag == tag) {
+        Frame f = std::move(*it);
+        pe.inbox.erase(it);
+        return f;
+      }
+    }
+    progress();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int MPI_Init(int *, char ***) {
+  if (g_init) return MPI_SUCCESS;
+  const char *r = getenv("SHIMMPI_RANK");
+  const char *s = getenv("SHIMMPI_SIZE");
+  const char *bp = getenv("SHIMMPI_BASE_PORT");
+  g_rank = r ? atoi(r) : 0;
+  g_size = s ? atoi(s) : 1;
+  int base = bp ? atoi(bp) : 47800;
+  g_peers.assign(g_size, Peer{});
+  if (g_size > 1) {
+    // listen for connections from higher ranks
+    g_listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(g_listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(base + g_rank);
+    if (bind(g_listen_fd, (sockaddr *)&addr, sizeof(addr)) != 0) die("bind");
+    if (listen(g_listen_fd, g_size) != 0) die("listen");
+    // connect to lower ranks (retry while they come up)
+    for (int p = 0; p < g_rank; ++p) {
+      int fd = -1;
+      for (int attempt = 0; attempt < 6000; ++attempt) {
+        fd = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in pa{};
+        pa.sin_family = AF_INET;
+        pa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        pa.sin_port = htons(base + p);
+        if (connect(fd, (sockaddr *)&pa, sizeof(pa)) == 0) break;
+        close(fd);
+        fd = -1;
+        usleep(10000);
+      }
+      if (fd < 0) die("connect");
+      int32_t me = g_rank;
+      if (write(fd, &me, 4) != 4) die("hello");
+      int one2 = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+      set_nonblock(fd, true);
+      g_peers[p].fd = fd;
+    }
+    // accept from higher ranks
+    for (int need = g_size - 1 - g_rank; need > 0; --need) {
+      int fd = accept(g_listen_fd, nullptr, nullptr);
+      if (fd < 0) die("accept");
+      int32_t who = -1;
+      if (read(fd, &who, 4) != 4) die("hello-read");
+      int one2 = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+      set_nonblock(fd, true);
+      g_peers[who].fd = fd;
+    }
+  }
+  g_reqs.reserve(1024);
+  g_init = true;
+  return MPI_SUCCESS;
+}
+
+int MPI_Initialized(int *flag) {
+  *flag = g_init ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) {
+  for (auto &p : g_peers)
+    if (p.fd >= 0) close(p.fd);
+  if (g_listen_fd >= 0) close(g_listen_fd);
+  g_init = false;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_rank(MPI_Comm, int *rank) {
+  *rank = g_rank;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_size(MPI_Comm, int *size) {
+  *size = g_size;
+  return MPI_SUCCESS;
+}
+
+int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm, MPI_Request *request) {
+  enqueue_send(dest, tag, buf, count * dtype_size(datatype));
+  g_reqs.push_back(RecvReq{nullptr, 0, dest, tag, true, 0, true, true});
+  *request = (int)g_reqs.size();  // index+1
+  return MPI_SUCCESS;
+}
+
+int MPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
+              int tag, MPI_Comm, MPI_Request *request) {
+  g_reqs.push_back(
+      RecvReq{buf, count * dtype_size(datatype), source, tag, false, 0,
+              true, false});
+  *request = (int)g_reqs.size();
+  return MPI_SUCCESS;
+}
+
+int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
+  if (*request == MPI_REQUEST_NULL) {
+    *flag = 1;
+    return MPI_SUCCESS;
+  }
+  RecvReq &rq = g_reqs[*request - 1];
+  if (rq.is_send) {  // eager-buffered: complete as soon as posted
+    *flag = 1;
+    if (status) {
+      status->MPI_SOURCE = rq.source;
+      status->MPI_TAG = rq.tag;
+      status->_count = 0;
+    }
+    *request = MPI_REQUEST_NULL;
+    return MPI_SUCCESS;
+  }
+  progress();
+  Peer &pe = g_peers[rq.source];
+  for (auto it = pe.inbox.begin(); it != pe.inbox.end(); ++it) {
+    if (it->tag == rq.tag) {
+      int n = (int)it->data.size();
+      if (n > rq.max_bytes) n = rq.max_bytes;
+      memcpy(rq.buf, it->data.data(), n);
+      rq.got_bytes = n;
+      rq.done = true;
+      pe.inbox.erase(it);
+      break;
+    }
+  }
+  *flag = rq.done ? 1 : 0;
+  if (rq.done) {
+    if (status) {
+      status->MPI_SOURCE = rq.source;
+      status->MPI_TAG = rq.tag;
+      status->MPI_ERROR = MPI_SUCCESS;
+      status->_count = rq.got_bytes;
+    }
+    *request = MPI_REQUEST_NULL;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Wait(MPI_Request *request, MPI_Status *status) {
+  int flag = 0;
+  while (*request != MPI_REQUEST_NULL && !flag) MPI_Test(request, &flag, status);
+  return MPI_SUCCESS;
+}
+
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
+                  int *count) {
+  *count = status->_count / dtype_size(datatype);
+  return MPI_SUCCESS;
+}
+
+int MPI_Barrier(MPI_Comm) {
+  if (g_size == 1) return MPI_SUCCESS;
+  uint8_t token = 1;
+  if (g_rank == 0) {
+    for (int p = 1; p < g_size; ++p) recv_frame_blocking(p, kTagBarrier);
+    for (int p = 1; p < g_size; ++p) enqueue_send(p, kTagBcast, &token, 1);
+    for (int p = 1; p < g_size; ++p) pump_write(p);
+  } else {
+    enqueue_send(0, kTagBarrier, &token, 1);
+    pump_write(0);
+    recv_frame_blocking(0, kTagBcast);
+  }
+  return MPI_SUCCESS;
+}
+
+}  // extern "C"
+
+template <typename T>
+static void reduce_typed(void *acc, const void *in, int n, intptr_t op) {
+  T *a = (T *)acc;
+  const T *b = (const T *)in;
+  for (int i = 0; i < n; ++i) {
+    switch (op) {
+      case 1: a[i] = a[i] + b[i]; break;
+      case 2: a[i] = b[i] < a[i] ? b[i] : a[i]; break;
+      case 3: a[i] = b[i] > a[i] ? b[i] : a[i]; break;
+      case 4: a[i] = a[i] * b[i]; break;
+    }
+  }
+}
+
+static void reduce_dispatch(MPI_Datatype d, void *acc, const void *in, int n,
+                            MPI_Op op) {
+  intptr_t o = (intptr_t)op;
+  switch ((intptr_t)d) {
+    case 2: case 8: reduce_typed<int32_t>(acc, in, n, o); break;
+    case 3: reduce_typed<uint8_t>(acc, in, n, o); break;
+    case 4: reduce_typed<int8_t>(acc, in, n, o); break;
+    case 5: reduce_typed<uint16_t>(acc, in, n, o); break;
+    case 6: reduce_typed<int16_t>(acc, in, n, o); break;
+    case 7: case 15: reduce_typed<uint32_t>(acc, in, n, o); break;
+    case 9: case 16: reduce_typed<uint64_t>(acc, in, n, o); break;
+    case 10: case 14: reduce_typed<int64_t>(acc, in, n, o); break;
+    case 11: reduce_typed<float>(acc, in, n, o); break;
+    case 12: reduce_typed<double>(acc, in, n, o); break;
+    case 13: case 1: reduce_typed<uint8_t>(acc, in, n, o); break;
+  }
+}
+
+extern "C" int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                             MPI_Datatype datatype, MPI_Op op, MPI_Comm) {
+  int bytes = count * dtype_size(datatype);
+  memcpy(recvbuf, sendbuf, bytes);
+  if (g_size == 1) return MPI_SUCCESS;
+  if (g_rank == 0) {
+    for (int p = 1; p < g_size; ++p) {
+      Frame f = recv_frame_blocking(p, kTagReduce);
+      reduce_dispatch(datatype, recvbuf, f.data.data(), count, op);
+    }
+    for (int p = 1; p < g_size; ++p) enqueue_send(p, kTagBcast, recvbuf, bytes);
+    for (int p = 1; p < g_size; ++p) pump_write(p);
+  } else {
+    enqueue_send(0, kTagReduce, sendbuf, bytes);
+    pump_write(0);
+    Frame f = recv_frame_blocking(0, kTagBcast);
+    memcpy(recvbuf, f.data.data(), bytes);
+  }
+  return MPI_SUCCESS;
+}
+
+extern "C" int MPI_Abort(MPI_Comm, int errorcode) {
+  fprintf(stderr, "[shimmpi %d] MPI_Abort(%d)\n", g_rank, errorcode);
+  abort();
+}

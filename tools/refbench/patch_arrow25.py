@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Port a COPY of the reference's cpp tree to build against pyarrow 25's
+bundled Arrow C++ (the pinned Arrow 2.0.0 download needs network egress
+this image doesn't have).  ~10 mechanical API-drift fixes, no
+algorithmic change — the point is to measure the reference, unmodified
+in behavior, on this host (BASELINE.md "Round 5").
+
+Usage:
+    cp -r /root/reference/cpp /tmp/refbuild/cylon
+    python3 tools/refbench/patch_arrow25.py /tmp/refbuild/cylon/src/cylon
+
+Never run against /root/reference itself (read-only by policy).
+"""
+import sys
+import os
+
+PATCHES = {
+    "ctx/arrow_memory_pool_utils.hpp": [
+        # Arrow >= 11 added alignment parameters to MemoryPool's virtuals
+        ("arrow::Status Allocate(int64_t size, uint8_t **out) override {",
+         "arrow::Status Allocate(int64_t size, int64_t /*alignment*/, uint8_t **out) override {"),
+        ("arrow::Status Reallocate(int64_t old_size, int64_t new_size, uint8_t **ptr) override {",
+         "arrow::Status Reallocate(int64_t old_size, int64_t new_size, int64_t /*alignment*/, uint8_t **ptr) override {"),
+        ("void Free(uint8_t *buffer, int64_t size) override {",
+         "void Free(uint8_t *buffer, int64_t size, int64_t /*alignment*/) override {"),
+        # new pure virtuals
+        ("""  int64_t max_memory() const override {
+    return this->tx_memory->max_memory();
+  }""",
+         """  int64_t max_memory() const override {
+    return this->tx_memory->max_memory();
+  }
+
+  int64_t total_bytes_allocated() const override {
+    return this->tx_memory->bytes_allocated();
+  }
+
+  int64_t num_allocations() const override {
+    return 0;
+  }"""),
+    ],
+    "join/join.cpp": [
+        ("arrow::util::string_view", "std::string_view"),
+    ],
+    "arrow/arrow_all_to_all.cpp": [
+        ("arrow::internal::HasValidityBitmap(type->id())",
+         "(arrow::internal::may_have_validity_bitmap(type->id()))"),
+    ],
+    "arrow/arrow_types.cpp": [
+        # DecimalType became abstract; 2.0's ctor was width/precision/scale
+        ("return std::make_shared<arrow::DecimalType>(width, precision, scale);",
+         "(void)width; return std::make_shared<arrow::Decimal128Type>(precision, scale);"),
+    ],
+    "compute/aggregates.cpp": [
+        ("arrow::compute::Sum(input, &exec_ctx)",
+         "arrow::compute::Sum(input, arrow::compute::ScalarAggregateOptions::Defaults(), &exec_ctx)"),
+        ("arrow::compute::CountOptions options(arrow::compute::CountOptions::COUNT_NON_NULL);",
+         "arrow::compute::CountOptions options(arrow::compute::CountOptions::ONLY_VALID);"),
+        ("arrow::compute::MinMaxOptions options(arrow::compute::MinMaxOptions::SKIP);",
+         "arrow::compute::ScalarAggregateOptions options = arrow::compute::ScalarAggregateOptions::Defaults();"),
+    ],
+    "compute/aggregate_utils.hpp": [
+        # numeric scalars dropped data()/mutable_data(); 'value' remains
+        ("""        status = cylon::mpi::AllReduce(send_scalar->data(),
+                                       rcv_scalar->mutable_data(),""",
+         """        status = cylon::mpi::AllReduce(&send_scalar->value,
+                                       &rcv_scalar->value,"""),
+    ],
+    "groupby/pipeline_groupby.cpp": [
+        ("arrow::compute::Sum(array, fn_ctx)",
+         "arrow::compute::Sum(array, arrow::compute::ScalarAggregateOptions::Defaults(), fn_ctx)"),
+        ("arrow::compute::MinMaxOptions::Defaults()",
+         "arrow::compute::ScalarAggregateOptions::Defaults()"),
+    ],
+    "io/arrow_io.cpp": [
+        ("arrow::csv::TableReader::Make(pool, *mmap_result, *read_options,",
+         "arrow::csv::TableReader::Make(arrow::io::IOContext(pool), *mmap_result, *read_options,"),
+    ],
+    "util/copy_arrray.cpp": [
+        # NumericBuilder<BooleanType> is no longer a valid instantiation;
+        # TypeTraits picks the right builder/array for every leaf type
+        ("""  arrow::NumericBuilder<TYPE> array_builder(memory_pool);
+  arrow::Status status = array_builder.Reserve(indices.size());""",
+         """  typename arrow::TypeTraits<TYPE>::BuilderType array_builder(memory_pool);
+  arrow::Status status = array_builder.Reserve(indices.size());"""),
+        ("  auto casted_array = std::static_pointer_cast<arrow::NumericArray<TYPE>>(data_array);",
+         "  auto casted_array = std::static_pointer_cast<typename arrow::TypeTraits<TYPE>::ArrayType>(data_array);"),
+        ("""  arrow::ListBuilder list_builder(memory_pool,
+                                  std::make_shared<arrow::NumericBuilder<TYPE>>(memory_pool));
+  arrow::NumericBuilder<TYPE> &value_builder =
+      *(static_cast<arrow::NumericBuilder<TYPE> *>(list_builder.value_builder()));""",
+         """  using ValueBuilderT = typename arrow::TypeTraits<TYPE>::BuilderType;
+  arrow::ListBuilder list_builder(memory_pool,
+                                  std::make_shared<ValueBuilderT>(memory_pool));
+  ValueBuilderT &value_builder =
+      *(static_cast<ValueBuilderT *>(list_builder.value_builder()));"""),
+        ("""    auto numericArray = std::static_pointer_cast<arrow::NumericArray<TYPE>>(
+        casted_array->Slice(index));""",
+         """    auto numericArray = std::static_pointer_cast<typename arrow::TypeTraits<TYPE>::ArrayType>(
+        casted_array->Slice(index));"""),
+    ],
+}
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 2
+    root = sys.argv[1]
+    if os.path.realpath(root).startswith("/root/reference"):
+        print("refusing to patch /root/reference (copy it first)")
+        return 2
+    for rel, subs in PATCHES.items():
+        path = os.path.join(root, rel)
+        with open(path) as f:
+            s = f.read()
+        for old, new in subs:
+            if old not in s:
+                if new in s:  # already applied
+                    continue
+                print(f"PATTERN NOT FOUND in {rel}:\n{old[:120]}")
+                return 1
+            s = s.replace(old, new)
+        with open(path, "w") as f:
+            f.write(s)
+        print(f"patched {rel}")
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

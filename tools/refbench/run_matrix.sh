@@ -1,0 +1,21 @@
+#!/bin/bash
+# Reference-cylon measurement matrix -> results.jsonl
+set -u
+OUT=results.jsonl
+: > $OUT
+run() {
+  echo "[matrix] np=$1 rows=$2 algo=$3" >&2
+  ./shim/shim_mpirun -n $1 ./bench_join_groupby $2 $3 ${4:-3} 2>/dev/null | grep '"driver"' >> $OUT
+}
+# bench.py CPU size (4.2M global)
+run 1 4194304 sort
+run 2 2097152 hash
+run 2 2097152 sort
+run 4 1048576 hash
+run 4 1048576 sort
+# TPU headline size (67M global) — np=1 first (hours? no: ~3M rows/s -> ~45s/rep)
+run 1 67108864 hash 2
+run 1 67108864 sort 2
+run 2 33554432 hash 2
+run 4 16777216 hash 2
+echo "[matrix] done" >&2

@@ -1,0 +1,35 @@
+#include "mpi.h"
+#include <cstdio>
+#include <cstring>
+#include <vector>
+int main() {
+  MPI_Init(nullptr, nullptr);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  MPI_Barrier(MPI_COMM_WORLD);
+  // ring exchange of 1MB buffers via Isend/Irecv/Test
+  int n = 1 << 20;
+  std::vector<int> out(n, rank), in(n, -1);
+  int dst = (rank + 1) % size, src = (rank + size - 1) % size;
+  MPI_Request sreq, rreq;
+  MPI_Irecv(in.data(), n, MPI_INT, src, 7, MPI_COMM_WORLD, &rreq);
+  MPI_Isend(out.data(), n, MPI_INT, dst, 7, MPI_COMM_WORLD, &sreq);
+  MPI_Status st;
+  int flag = 0;
+  while (!flag) MPI_Test(&rreq, &flag, &st);
+  int cnt;
+  MPI_Get_count(&st, MPI_INT, &cnt);
+  if (cnt != n || in[0] != src || in[n - 1] != src) {
+    fprintf(stderr, "rank %d: BAD (cnt=%d in0=%d)\n", rank, cnt, in[0]);
+    return 1;
+  }
+  long v = rank + 1, sum = 0;
+  MPI_Allreduce(&v, &sum, 1, MPI_INT64_T, MPI_SUM, MPI_COMM_WORLD);
+  long expect = (long)size * (size + 1) / 2;
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (sum != expect) { fprintf(stderr, "rank %d: allreduce BAD\n", rank); return 1; }
+  if (rank == 0) printf("shimmpi smoke OK: size=%d allreduce=%ld\n", size, sum);
+  MPI_Finalize();
+  return 0;
+}

@@ -80,6 +80,12 @@ CYLON_TPU_SEGSUM=scatter CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
     > "$OUT/bench_segscatter.json" 2> "$OUT/bench_segscatter.log"
 log "bench segscatter rc=$? $(head -c 200 "$OUT/bench_segscatter.json" 2>/dev/null)"
 
+log "7b/9 bench (PALLAS two-sweep segmented scan, one size down) — round-5 bet"
+CYLON_TPU_SEGSUM=pallas CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
+    timeout 1600 python bench.py \
+    > "$OUT/bench_segpallas.json" 2> "$OUT/bench_segpallas.log"
+log "bench segpallas rc=$? $(head -c 200 "$OUT/bench_segpallas.json" 2>/dev/null)"
+
 log "8/9 kernel smoke"
 timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"
 log "smoke rc=$?"
@@ -96,10 +102,15 @@ log "done; artifacts in $OUT"
 OUT_ABS=$(realpath "$OUT" 2>/dev/null || echo "$OUT")
 case "$OUT_ABS" in
   "$PWD"/*)
-    git add -A "$OUT_ABS" 2>/dev/null \
+    if git add -A "$OUT_ABS" 2>/dev/null \
       && git commit -m "TPU battery artifacts: $(basename "$OUT_ABS") $(date -u +%Y-%m-%dT%H:%MZ)" \
-         -- "$OUT_ABS" >/dev/null 2>&1 \
-      && log "artifacts committed" || log "artifact commit skipped"
+         -- "$OUT_ABS" >/dev/null 2>&1; then
+      log "artifacts committed"
+    else
+      # unstage so a later unrelated commit cannot sweep these in
+      git reset -q -- "$OUT_ABS" 2>/dev/null
+      log "artifact commit skipped"
+    fi
     ;;
   *) log "artifacts outside repo; not committed" ;;
 esac

@@ -119,6 +119,28 @@ def main() -> int:
 
     record("prefix_segsum_groupby", prefix_segsum)
 
+    def pallas_segscan():
+        # the two-sweep Pallas scan must agree with the associative-scan
+        # path ON HARDWARE (pltpu.roll semantics and the carry chain are
+        # exactly what interpret mode cannot prove)
+        from cylon_tpu.ops import pallas_scan
+
+        n = 1 << 20
+        x = jnp.asarray(rng.random(n).astype(np.float32))
+        r = jnp.asarray(rng.random(n) < 0.01).at[0].set(True)
+        got = pallas_scan.segmented_scan(x, r, "sum", interpret=False)
+
+        def combine(a, b):
+            va, fa = a
+            vb, fb = b
+            return jnp.where(fb, vb, va + vb), fa | fb
+
+        exp, _ = jax.lax.associative_scan(combine, (x, r))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   rtol=1e-5, atol=1e-6)
+
+    record("pallas_segmented_scan", pallas_segscan)
+
     # distributed ops on a 1-device mesh: exercises shard_map + collectives
     # + the RaggedAllToAll exchange on the real chip
     ctx = CylonContext.InitDistributed(TPUConfig(world_size=1))
