@@ -50,7 +50,12 @@ def _touch(out):
     np.asarray(jax.device_get(leaf[:1]))
 
 
-def timed(name, fn, *args):
+def timed(name, fn, *args, traffic_bytes=None):
+    """traffic_bytes: MINIMUM HBM traffic for the stage (each operand set
+    read once + written once).  The printed GB/s(min) over the chip's
+    peak (~819 GB/s on v5e) bounds the stage's efficiency from above —
+    the roofline column the round-4 verdict asked for; a stage far below
+    peak is re-traversing or serializing."""
     out = fn(*args)
     _touch(out)
     ts = []
@@ -59,7 +64,12 @@ def timed(name, fn, *args):
         out = fn(*args)
         _touch(out)
         ts.append(time.perf_counter() - t0)
-    print(f"{name:34s} {min(ts)*1e3:10.1f} ms", flush=True)
+    sec = min(ts)
+    gbs = ""
+    if traffic_bytes:
+        rate = traffic_bytes / sec / 1e9
+        gbs = f" {rate:7.1f} GB/s(min) {100 * rate / 819:5.1f}%v5e-peak"
+    print(f"{name:34s} {sec*1e3:10.1f} ms{gbs}", flush=True)
     return out
 
 
@@ -70,8 +80,10 @@ def stage_sort(cl, cr, cnt):
         cl, cnt, cr, cnt, (0,), (0,))
     return perm, new_group, is_run_end, live_sorted
 
+N2 = 2 * ROWS
 sorted_parts = timed("combined sort + run boundaries", stage_sort,
-                     cols_l, cols_r, count)
+                     cols_l, cols_r, count,
+                     traffic_bytes=N2 * 8 * 2 + N2 * 3)
 
 # -- stage 1b: sort-mode A/B on identical operands -------------------------
 # CYLON_TPU_SORT is read at TRACE time, so each variant gets its own jit
@@ -119,7 +131,7 @@ def stage_extents(perm, new_group, is_run_end, live_sorted):
     return segments.run_extents(is_right & live_sorted, new_group, is_run_end)
 
 extents = timed("run extents (cumsum+cummax+cummin)", stage_extents,
-                *sorted_parts)
+                *sorted_parts, traffic_bytes=N2 * (3 + 4 * 4))
 
 # -- stage 3: back-map + partition (the real _match_ranges tail) -----------
 # Realized per compact.permute_mode() — the inverse-permute back-map and
@@ -134,7 +146,8 @@ def stage_back(perm, lo_sorted, matches_sorted):
     return back, perm_r, left_key_order
 
 timed(f"back-map + partition ({compact.permute_mode()})", stage_back,
-      sorted_parts[0], extents[0], extents[1])
+      sorted_parts[0], extents[0], extents[1],
+      traffic_bytes=N2 * 4 * (3 * 2 + 2 * 2 + 3))
 
 
 def _permute_variant(label, mode):
@@ -193,7 +206,8 @@ if live != m:  # stale cache entry: re-size before any timing
     full_join = make_full_join(out_cap)
 _bench._save_join_count(ROWS, m)  # verified by the live join
 
-joined = timed("join_gather total", full_join, cols_l, cols_r, count)
+joined = timed("join_gather total", full_join, cols_l, cols_r, count,
+               traffic_bytes=N2 * 8 * 2 + N2 * 4 * 14 + out_cap * 4 * 6)
 
 # -- groupby on joined -----------------------------------------------------
 @jax.jit
@@ -201,9 +215,11 @@ def stage_gb(jcols, jm):
     return groupby_mod.pipeline_groupby(jcols, jm, (0,),
                                         ((1, AggOp.SUM), (2, AggOp.MEAN)), 0)
 
-timed("pipeline_groupby", stage_gb, joined[0], joined[1])
+timed("pipeline_groupby", stage_gb, joined[0], joined[1],
+      traffic_bytes=out_cap * 4 * 8)
 
 # -- fused end-to-end ------------------------------------------------------
 pipeline = _bench.make_bench_pipeline(out_cap, "sort")  # THE bench program
-timed("FULL fused pipeline", pipeline, cols_l, count, cols_r, count)
+timed("FULL fused pipeline", pipeline, cols_l, count, cols_r, count,
+      traffic_bytes=N2 * 8 * 2 + N2 * 4 * 14 + out_cap * 4 * 14)
 print(f"done @ {ROWS} rows/side", flush=True)
