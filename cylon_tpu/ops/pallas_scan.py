@@ -185,6 +185,98 @@ def _segmented_scan_padded(x2: jax.Array, r2: jax.Array, op: str, bm: int,
     )(partial_scan, r2, carry_in)
 
 
+def _block_scan_plain(v: jax.Array, op: str, bm: int,
+                      interpret: bool) -> jax.Array:
+    """Inclusive (unsegmented) Hillis-Steele scan along the lane axis —
+    the flags-free fast path for cumsum/cummax/cummin."""
+    fn = _FNS[op]
+    lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+    d = 1
+    while d < bm:
+        vs = _roll_right(v, d, interpret)
+        v = jnp.where(lane >= d, fn(vs, v), v)
+        d *= 2
+    return v
+
+
+def _sweep1_plain_kernel(op: str, bm: int, interpret: bool, x_ref, out_ref,
+                         tot_ref, carry):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        carry[:] = jnp.full(carry.shape, _neutral(carry.dtype, op))
+
+    v = _FNS[op](carry[:], _block_scan_plain(x_ref[:], op, bm, interpret))
+    out_ref[:] = v
+    carry[:] = v[:, -1:]
+    tot_ref[:] = carry[:]
+
+
+@functools.partial(jax.jit, static_argnames=("op", "bm", "interpret"))
+def _scan_padded(x2: jax.Array, op: str, bm: int, interpret: bool):
+    m = x2.shape[1]
+    grid = (m // bm,)
+    blk = pl.BlockSpec((_SUBLANES, bm), lambda i: (0, i))
+    col = pl.BlockSpec((_SUBLANES, 1), lambda i: (0, 0))
+    partial_scan, totals = pl.pallas_call(
+        functools.partial(_sweep1_plain_kernel, op, bm, interpret),
+        grid=grid,
+        in_specs=[blk],
+        out_specs=(blk, col),
+        out_shape=(jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+                   jax.ShapeDtypeStruct((_SUBLANES, 1), x2.dtype)),
+        scratch_shapes=[pltpu.VMEM((_SUBLANES, 1), x2.dtype)],
+        interpret=interpret,
+    )(x2)
+    # sweep 2 degenerates to one fused broadcast: carry_in[s] combines
+    # into every element of sublane s (no segment boundaries to respect)
+    fn = _FNS[op]
+    tv = jax.lax.associative_scan(fn, totals[:, 0])
+    neutral = _neutral(x2.dtype, op)
+    carry_in = jnp.concatenate([jnp.full((1,), neutral, x2.dtype), tv[:-1]])
+    return fn(carry_in[:, None], partial_scan)
+
+
+def _layout_1d(x: jax.Array, op: str, interpret: "bool | None",
+               block_lanes: "int | None"):
+    """Shared entry layout: validate, resolve interpret, pad ``x`` with
+    the op's neutral to a whole (128, m) grid of bm-lane blocks.
+    Returns (x2, bm, interpret) — single-sourced so scan_1d and
+    segmented_scan can never disagree on the view."""
+    if x.ndim != 1 or x.dtype.itemsize != 4:
+        raise ValueError("pallas scan: 1-D 32-bit input required")
+    if interpret is None:
+        from .. import precision
+        interpret = not precision.on_tpu()
+    bm = block_lanes or _BLOCK_LANES
+    n = x.shape[0]
+    m = -(-n // _SUBLANES)
+    m = -(-m // bm) * bm
+    pad = _SUBLANES * m - n
+    neutral = _neutral(x.dtype, op)
+    xp = jnp.concatenate([x, jnp.full((pad,), neutral, x.dtype)]) if pad else x
+    return xp.reshape(_SUBLANES, m), bm, interpret, pad
+
+
+def scan_1d(x: jax.Array, op: str, reverse: bool = False,
+            interpret: bool | None = None,
+            block_lanes: int | None = None) -> jax.Array:
+    """Inclusive scan of 1-D 32-bit ``x`` (cumsum/cummax/cummin family) —
+    the Pallas sweep plus one broadcast combine instead of the ~log2(n)
+    passes XLA materializes for lax.cumsum/cummax/cummin on this
+    backend.  ``reverse=True`` scans right-to-left (the cummin
+    run_extents needs) via flips that XLA fuses into the pad/reshape."""
+    n = x.shape[0]
+    if n == 0:
+        return x
+    if reverse:
+        x = jnp.flip(x)
+    x2, bm, interpret, _pad = _layout_1d(x, op, interpret, block_lanes)
+    out = _scan_padded(x2, op, bm, interpret).reshape(-1)[:n]
+    return jnp.flip(out) if reverse else out
+
+
 def segmented_scan(x: jax.Array, reset: jax.Array, op: str,
                    interpret: bool | None = None,
                    block_lanes: int | None = None) -> jax.Array:
@@ -193,23 +285,13 @@ def segmented_scan(x: jax.Array, reset: jax.Array, op: str,
     ``lax.associative_scan`` inside segments.segmented_reduce_sorted.
     Padding appended by the layout (to 128*bm granularity) is neutral
     with no resets, so it never perturbs real prefixes."""
-    if x.ndim != 1 or x.dtype.itemsize != 4:
-        raise ValueError("segmented_scan: 1-D 32-bit input required")
-    if interpret is None:
-        from .. import precision
-        interpret = not precision.on_tpu()
     n = x.shape[0]
     if n == 0:
         return x
-    bm = block_lanes or _BLOCK_LANES
-    m = -(-n // _SUBLANES)
-    m = -(-m // bm) * bm
-    pad = _SUBLANES * m - n
-    neutral = _neutral(x.dtype, op)
-    xp = jnp.concatenate([x, jnp.full((pad,), neutral, x.dtype)]) if pad else x
+    x2, bm, interpret, pad = _layout_1d(x, op, interpret, block_lanes)
     rp = reset.astype(jnp.uint32)
     if pad:
         rp = jnp.concatenate([rp, jnp.zeros((pad,), jnp.uint32)])
-    out2 = _segmented_scan_padded(xp.reshape(_SUBLANES, m),
-                                  rp.reshape(_SUBLANES, m), op, bm, interpret)
+    out2 = _segmented_scan_padded(x2, rp.reshape(_SUBLANES, x2.shape[1]),
+                                  op, bm, interpret)
     return out2.reshape(-1)[:n]

@@ -58,14 +58,56 @@ def run_extents(member: jax.Array, new_group: jax.Array,
     Precondition (as for segment_spans): ``new_group[0]`` must be True for
     nonempty input — otherwise ``start`` stays -1 across the first run.
     All callers satisfy it because rows_equal_adjacent forces row 0 to
-    start a run."""
+    start a run.
+
+    CYLON_TPU_SCAN=pallas routes the three scans through the two-sweep
+    Pallas kernel (ops/pallas_scan.scan_1d) — same keep-or-kill A/B
+    discipline as CYLON_TPU_SEGSUM=pallas; default stays XLA until the
+    hardware verdict."""
     n = member.shape[0]
+    if _pallas_plain_scan_selected():
+        from . import pallas_scan
+
+        incl = pallas_scan.scan_1d(member.astype(jnp.int32), "sum")
+        excl = incl - member.astype(jnp.int32)
+        start = pallas_scan.scan_1d(
+            jnp.where(new_group, excl, jnp.int32(-1)), "max")
+        end = pallas_scan.scan_1d(
+            jnp.where(is_run_end, incl, jnp.int32(n + 1)), "min",
+            reverse=True)
+        return start, end - start
     incl = jnp.cumsum(member.astype(jnp.int32))
     excl = incl - member.astype(jnp.int32)
     start = jax.lax.cummax(jnp.where(new_group, excl, jnp.int32(-1)))
     end = jax.lax.cummin(jnp.where(is_run_end, incl, jnp.int32(n + 1)),
                          reverse=True)
     return start, end - start
+
+
+_SCAN_MODE: "str | None" = None  # None = read CYLON_TPU_SCAN
+
+
+def set_scan(mode: "str | None") -> None:
+    """Force ``"pallas"`` or ``"xla"`` plain scans in run_extents (None =
+    env).  Clears jit caches like set_segsum — the knob is read at trace
+    time inside jitted pipelines, so an env flip alone would silently
+    keep the cached path and poison any in-process A/B."""
+    global _SCAN_MODE
+    if mode not in (None, "pallas", "xla"):
+        raise ValueError(f"scan mode must be pallas/xla, got {mode}")
+    if mode != _SCAN_MODE:
+        jax.clear_caches()
+    _SCAN_MODE = mode
+
+
+def _pallas_plain_scan_selected() -> bool:
+    """Whether run_extents' cumsum/cummax/cummin ride the Pallas scan
+    (CYLON_TPU_SCAN=pallas / set_scan).  Read at trace time."""
+    if _SCAN_MODE is not None:
+        return _SCAN_MODE == "pallas"
+    import os
+
+    return os.environ.get("CYLON_TPU_SCAN") == "pallas"
 
 
 def _span_take(csum0: jax.Array, pos: jax.Array) -> jax.Array:

@@ -124,4 +124,11 @@ flags = a < (1 << 27)
 timed("pallas segmented_scan (sum,flag)",
       lambda x, f: pallas_scan.segmented_scan(x, f, "sum"), c, flags,
       traffic_bytes=6 * B4)
+# 4 passes: sweep-1 read+write, then the (unfused) broadcast combine
+# read+write — counted like segmented_scan's 6*B4 above
+timed("pallas scan_1d cumsum f32",
+      lambda x: pallas_scan.scan_1d(x, "sum"), c, traffic_bytes=4 * B4)
+timed("pallas scan_1d cummin i32 rev",
+      lambda x: pallas_scan.scan_1d(x.astype(jnp.int32), "min",
+                                    reverse=True), a, traffic_bytes=4 * B4)
 print("done", flush=True)

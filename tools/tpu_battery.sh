@@ -80,11 +80,17 @@ CYLON_TPU_SEGSUM=scatter CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
     > "$OUT/bench_segscatter.json" 2> "$OUT/bench_segscatter.log"
 log "bench segscatter rc=$? $(head -c 200 "$OUT/bench_segscatter.json" 2>/dev/null)"
 
-log "7b/9 bench (PALLAS two-sweep segmented scan, one size down) — round-5 bet"
+log "7b/9 bench (PALLAS segmented scan only, one size down) — round-5 bet, isolated"
 CYLON_TPU_SEGSUM=pallas CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
     timeout 1600 python bench.py \
     > "$OUT/bench_segpallas.json" 2> "$OUT/bench_segpallas.log"
 log "bench segpallas rc=$? $(head -c 200 "$OUT/bench_segpallas.json" 2>/dev/null)"
+
+log "7c/9 bench (PALLAS run_extents scan only, one size down) — isolated"
+CYLON_TPU_SCAN=pallas CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
+    timeout 1600 python bench.py \
+    > "$OUT/bench_scanpallas.json" 2> "$OUT/bench_scanpallas.log"
+log "bench scanpallas rc=$? $(head -c 200 "$OUT/bench_scanpallas.json" 2>/dev/null)"
 
 log "8/9 kernel smoke"
 timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"

@@ -1,0 +1,73 @@
+"""Minimal-repro hunt for the XLA:CPU compiler segfault that kills
+cache-cold full-tree test runs (~35% in, inside backend_compile_and_load;
+every crashing test passes alone — see tests/conftest.py).
+
+Hypothesis: the crash needs accumulated in-process compiler state, not
+any one program.  This driver compiles many small DISTINCT programs
+(shape/constant/structure variation like the test tree's) in one
+process and reports how far it got — run under a cache-cold dir:
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        CYLON_TEST_NO_COMPILE_CACHE=1 python tools/xla_cpu_crash_repro.py 800
+
+Exit 0 = no crash at this count (hypothesis needs the real tree's
+programs); a segfault before the final line IS the repro.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import faulthandler
+
+faulthandler.enable()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+
+
+def main():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("x", "y"))
+    rng = np.random.default_rng(0)
+    for i in range(N):
+        n = 64 + 8 * (i % 37)
+        k = 1 + i % 5
+
+        def prog(x):
+            y = x
+            for j in range(k):
+                y = jnp.sort(y * (j + 2)) + jnp.cumsum(y)
+            seg = (y.astype(jnp.int32) % 7 + i % 11).clip(0, 15)
+            z = jax.ops.segment_sum(y, seg, 16)
+            return z[: 1 + i % 3], jnp.argsort(y)
+
+        x = jnp.asarray(rng.random(n).astype(np.float32))
+        jax.jit(prog)(x)
+        if i % 16 == 0:
+            @jax.jit
+            def dist(a):
+                f = shard_map(lambda v: jax.lax.psum(jnp.sum(v) * i, "x"),
+                              mesh=mesh, in_specs=P("x"), out_specs=P())
+                return f(a)
+            dist(jnp.ones((8 * (1 + i % 4),), jnp.float32))
+        if i % 50 == 0:
+            print(f"compiled {i}/{N}", flush=True)
+    print(f"no crash after {N} distinct compilations", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
