@@ -49,10 +49,15 @@ def _log(msg: str) -> None:
 
 
 # Keys a legacy cache fragment may lack; absent means the fragment was
-# measured before the knob existed, i.e. under the OLD scatter defaults.
-# Single-sourced so seeding and artifact assembly can never disagree
-# about what an absent key means (round-4 advice finding 3).
-_LEGACY_DEFAULTS = {"segsum": "scatter", "permute": "scatter"}
+# measured before the knob's reporting existed — the OLD scatter
+# defaults for segsum/permute, XLA scans for scan (the only pre-existing
+# cache entry, round 2's, really did ride XLA scans; any hypothetical
+# fragment measured under an unreported knob is additionally flagged by
+# the fingerprint stale_code gate).  Single-sourced so seeding and
+# artifact assembly can never disagree about what an absent key means
+# (round-4 advice finding 3).
+_LEGACY_DEFAULTS = {"segsum": "scatter", "permute": "scatter",
+                    "scan": "xla"}
 
 
 def _code_fingerprint() -> str:
@@ -346,6 +351,7 @@ def _worker(backend: str, skip: int = 0) -> int:
         # report the EFFECTIVE reduction path, not the env request: the
         # scan paths only engage under narrow mode with the exact knob
         segsum = _segs.effective_mode() if _prec.narrow() else "scatter"
+        scan = _segs.plain_scan_mode()
         from cylon_tpu.ops import compact as _compact
 
         frag = {"value": value, "rows": rows, "backend": plat,
@@ -353,6 +359,7 @@ def _worker(backend: str, skip: int = 0) -> int:
                 "algo": os.environ.get("CYLON_BENCH_ALGO", "sort"),
                 "sort_mode": os.environ.get("CYLON_TPU_SORT", "cmp"),
                 "segsum": segsum,
+                "scan": scan,
                 "permute": _compact.permute_mode()}
         if passes > 1:
             frag["passes"] = passes
@@ -514,6 +521,7 @@ class _Bench:
             "algo": r.get("algo", "sort"),
             "sort_mode": r.get("sort_mode", "cmp"),
             "segsum": r.get("segsum", _LEGACY_DEFAULTS["segsum"]),
+            "scan": r.get("scan", _LEGACY_DEFAULTS["scan"]),
             "permute": r.get("permute", _LEGACY_DEFAULTS["permute"]),
             "source": source,
         }
@@ -559,6 +567,7 @@ class _Bench:
                 and r.get("segsum", _LEGACY_DEFAULTS["segsum"]) == "prefix" \
                 and r.get("sort_mode", "cmp") == "cmp" \
                 and r.get("permute", _LEGACY_DEFAULTS["permute"]) == "sort" \
+                and r.get("scan", _LEGACY_DEFAULTS["scan"]) == "xla" \
                 and not r.get("passes") \
                 and beats_cur:
             # the seed is the best default-config TPU number for the

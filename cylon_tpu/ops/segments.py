@@ -100,6 +100,13 @@ def set_scan(mode: "str | None") -> None:
     _SCAN_MODE = mode
 
 
+def plain_scan_mode() -> str:
+    """The plain-scan path trace-time state selects: ``"pallas"`` |
+    ``"xla"`` (public accessor — bench reporting keys on it, like
+    effective_mode for segsum)."""
+    return "pallas" if _pallas_plain_scan_selected() else "xla"
+
+
 def _pallas_plain_scan_selected() -> bool:
     """Whether run_extents' cumsum/cummax/cummin ride the Pallas scan
     (CYLON_TPU_SCAN=pallas / set_scan).  Read at trace time."""

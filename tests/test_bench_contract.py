@@ -73,6 +73,27 @@ def test_live_result_supersedes_foreign_fingerprint_seed(tmp_path,
     assert b.result["source"] == "live" and "stale_code" not in b.result
 
 
+def test_experiment_fragments_never_seed_cache(tmp_path, monkeypatch):
+    """Experiment-arm fragments (pallas segsum / pallas scan / hash algo)
+    must not become the default-config cache seed the next round's
+    provisional artifact reads."""
+    bench = _load_bench_module()
+    cache = tmp_path / ".bench_cache.json"
+    monkeypatch.setattr(bench, "CACHE_PATH", str(cache))
+    cache.write_text(json.dumps({"tpu": None, "pandas": {}}))
+    b = bench._Bench(budget_s=1.0)
+    base = {"value": 5.0e6, "rows": 1 << 20, "backend": "tpu",
+            "sort_mode": "cmp", "permute": "sort"}
+    for exp in ({"algo": "hash", "segsum": "prefix", "scan": "xla"},
+                {"algo": "sort", "segsum": "pallas", "scan": "xla"},
+                {"algo": "sort", "segsum": "prefix", "scan": "pallas"}):
+        b.accept(dict(base, **exp), source="live")
+        assert json.loads(cache.read_text()).get("tpu") is None, exp
+    b.accept(dict(base, algo="sort", segsum="prefix", scan="xla"),
+             source="live")
+    assert json.loads(cache.read_text())["tpu"]["value"] == 5.0e6
+
+
 def time_today() -> str:
     import time as _t
 
