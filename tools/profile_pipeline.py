@@ -125,13 +125,55 @@ if not os.environ.get("CYLON_TPU_PROFILE_SKIP_RADIX"):
                   {"CYLON_TPU_SORT": "radix", "CYLON_TPU_RADIX_SCAN": "xla"})
 
 # -- stage 2: run extents (prefix arithmetic) ------------------------------
-@jax.jit
-def stage_extents(perm, new_group, is_run_end, live_sorted):
-    is_right = perm >= cap
-    return segments.run_extents(is_right & live_sorted, new_group, is_run_end)
+def _mode_variant(label, setter, mode, stage_fn, args, traffic_bytes,
+                  compare_to=None):
+    """Shared scaffold for the per-stage mode A/Bs: pin the mode via its
+    cache-clearing setter (an env knob alone would let ambient
+    CYLON_TPU_* collapse the A/B into a mode vs itself — both arms are
+    pinned, baseline included), jit fresh, time, optionally assert exact
+    agreement (mismatch is FATAL like the stage-1b sort A/B: mismatched
+    timings must not be trusted), restore."""
+    setter(mode)
 
-extents = timed("run extents (cumsum+cummax+cummin)", stage_extents,
-                *sorted_parts, traffic_bytes=N2 * (3 + 4 * 4))
+    stage = jax.jit(stage_fn)
+    try:
+        out = timed(label, stage, *args, traffic_bytes=traffic_bytes)
+        if compare_to is not None:
+            same = bool(jax.device_get(
+                jnp.all(jnp.stack([jnp.array_equal(a, b) for a, b
+                                   in zip(out, compare_to)]))))
+            print(f"{label:34s} agrees with baseline: {same}", flush=True)
+            if not same:
+                raise SystemExit(f"{label}: MISMATCH vs baseline — its "
+                                 f"timing in this profile is INVALID")
+        return out
+    except SystemExit:
+        raise
+    except Exception as e:
+        print(f"{label:34s} FAILED: {type(e).__name__}: {str(e)[:200]}",
+              flush=True)
+        return None
+    finally:
+        setter(None)
+
+
+def _extents_stage(perm, new_group, is_run_end, live_sorted):
+    is_right = perm >= cap
+    return segments.run_extents(is_right & live_sorted, new_group,
+                                is_run_end)
+
+
+# baseline pinned to XLA scans (not the ambient env) so the A/B labels
+# are always true
+extents = _mode_variant("run extents (XLA scans)", segments.set_scan,
+                        "xla", _extents_stage, sorted_parts,
+                        N2 * (3 + 4 * 4))
+if extents is None:
+    raise SystemExit("baseline run-extents stage failed; downstream "
+                     "stages cannot be timed")
+_mode_variant("run extents (PALLAS scan_1d)", segments.set_scan, "pallas",
+              _extents_stage, sorted_parts, N2 * (3 + 4 * 4),
+              compare_to=extents)
 
 # -- stage 3: back-map + partition (the real _match_ranges tail) -----------
 # Realized per compact.permute_mode() — the inverse-permute back-map and
@@ -210,13 +252,19 @@ joined = timed("join_gather total", full_join, cols_l, cols_r, count,
                traffic_bytes=N2 * 8 * 2 + N2 * 4 * 14 + out_cap * 4 * 6)
 
 # -- groupby on joined -----------------------------------------------------
-@jax.jit
-def stage_gb(jcols, jm):
+def _gb_stage(jcols, jm):
     return groupby_mod.pipeline_groupby(jcols, jm, (0,),
                                         ((1, AggOp.SUM), (2, AggOp.MEAN)), 0)
 
-timed("pipeline_groupby", stage_gb, joined[0], joined[1],
-      traffic_bytes=out_cap * 4 * 8)
+
+# every segsum realization pinned explicitly (ambient CYLON_TPU_SEGSUM
+# cannot relabel an arm; no agreement assert — float accumulation order
+# legitimately differs across realizations)
+for _label, _mode in (("pipeline_groupby (segsum prefix)", "prefix"),
+                      ("pipeline_groupby (segsum scatter)", "scatter"),
+                      ("pipeline_groupby (segsum PALLAS)", "pallas")):
+    _mode_variant(_label, segments.set_segsum, _mode, _gb_stage,
+                  (joined[0], joined[1]), out_cap * 4 * 8)
 
 # -- fused end-to-end ------------------------------------------------------
 pipeline = _bench.make_bench_pipeline(out_cap, "sort")  # THE bench program
