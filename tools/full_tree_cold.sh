@@ -1,0 +1,18 @@
+#!/bin/bash
+# Reproduce the XLA:CPU full-tree compiler segfault: the WHOLE test tree
+# (fast+slow, one process, compile cache disabled) with faulthandler so
+# the crash point and native trace are captured.  Usage:
+#   tools/full_tree_cold.sh [outfile]
+# Exit 0 = no crash (suite green); 139/134 = the repro, with the dying
+# test visible at the tail of the log.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/full_tree_cold.log}
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
+    timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \
+    > "$OUT" 2>&1
+rc=$?
+echo "full-tree cold run rc=$rc; tail:" >&2
+tail -5 "$OUT" >&2
+exit $rc
