@@ -322,12 +322,13 @@ def _worker(backend: str, skip: int = 0) -> int:
         # (and possibly hang on) the tunnel
         jax.config.update("jax_platforms", "cpu")
 
-    try:  # persistent compile cache: the 67M-row pipeline compile is slow
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(_HERE, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception as e:
-        _log(f"compile cache unavailable: {e}")
+    # persistent compile cache: the 67M-row pipeline compile is slow.
+    # Per-backend dir (utils/compile_cache.py): axon-serialized
+    # executables SIGSEGV pure-CPU processes that deserialize them.
+    from cylon_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    if enable_persistent_compile_cache() is None:
+        _log("compile cache disabled/unavailable")
 
     dev0 = jax.devices()[0]
     plat = dev0.platform

@@ -1,0 +1,55 @@
+"""The ONE way to enable the persistent XLA compile cache.
+
+Root cause of the long-standing "full-tree XLA:CPU segfault"
+(tools/full_tree_cold.sh reproduced it 2026-07-31, faulthandler stack in
+PERF.md): every driver pointed at a SINGLE shared ``.jax_cache`` dir, so
+executables serialized by processes with one XLA CPU target config (the
+axon/TPU-attached bench worker and watcher probe embed pseudo-features
+like ``+prefer-no-scatter``) were deserialized by pure-CPU test
+processes with another — ``backend.deserialize_executable`` SIGSEGVs on
+the mismatch (the cpu_aot_loader "machine type doesn't match … could
+lead to execution errors such as SIGILL" warning is the polite version).
+The crash needed the whole tree because ``examples/util.default_ctx``
+enabled the cache mid-run for every later test, unconditionally — which
+is also why each crashing test passed in isolation.
+
+Fix: cache dirs are PER BACKEND (``.jax_cache_cpu``, ``.jax_cache_axon``,
+…), so no process ever deserializes an executable produced under a
+different target config, and CYLON_TEST_NO_COMPILE_CACHE=1 is honored by
+every enabler, not just the test conftest.
+"""
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def enable_persistent_compile_cache(min_compile_secs: float = 5,
+                                    root: "str | None" = None) -> "str | None":
+    """Point jax's persistent compile cache at ``<root>/.jax_cache_<backend>``
+    and return the directory (None when disabled via
+    CYLON_TEST_NO_COMPILE_CACHE=1 or when jax is unavailable).  Safe to
+    call multiple times; the backend suffix comes from
+    ``jax.default_backend()``, which initializes the backend — call it
+    only in driver/harness code, never at library import time."""
+    if os.environ.get("CYLON_TEST_NO_COMPILE_CACHE") == "1":
+        return None
+    try:
+        import jax
+
+        path = os.path.join(root or _REPO_ROOT,
+                            f".jax_cache_{jax.default_backend()}")
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+        return path
+    except Exception as e:
+        # visible, not fatal: a silently absent cache costs ~30s/kernel
+        # per tunnel window (smoke) and re-compiles everywhere else
+        import sys
+
+        print(f"[compile_cache] persistent cache unavailable: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        return None

@@ -10,17 +10,17 @@ import sys
 def default_ctx(world: int | None = None):
     """Distributed context over all visible devices (or ``world`` of them);
     plain local context when only one device exists."""
-    import os
-
     import jax
 
-    try:  # persistent compile cache (shared with bench/profiler/smoke)
-        jax.config.update("jax_compilation_cache_dir", os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:
-        pass
+    # per-backend persistent compile cache, honoring the test gate — this
+    # call used to point every process at ONE shared dir, which enabled
+    # the cache mid-test-tree and let pure-CPU tests deserialize
+    # executables serialized under the axon processes' different XLA
+    # target config: the root cause of the full-tree SIGSEGV
+    # (cylon_tpu/utils/compile_cache.py has the full story)
+    from cylon_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
 
     from cylon_tpu import CylonContext, TPUConfig
 

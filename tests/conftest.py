@@ -31,19 +31,21 @@ assert jax.default_backend() == "cpu"
 assert len(jax.devices()) == 8, jax.devices()
 
 # Persistent compile cache: the full tree compiles many hundreds of XLA
-# programs in one process, which (a) dominates suite wall time and (b) can
-# segfault XLA:CPU's compiler under accumulated state (observed twice at
-# ~35% of the full tree, in backend_compile_and_load; each crashing test
-# passes in isolation).  A warm cache removes almost all in-process
-# compilation on repeat runs — both the time and the crash surface.
-# Threshold 0: the crashing compiles are tiny (ms) — they must be
-# cacheable or reruns re-enter the crash. CYLON_TEST_NO_COMPILE_CACHE=1
-# disables for a cold-compile run.
-if os.environ.get("CYLON_TEST_NO_COMPILE_CACHE") != "1":
-    jax.config.update("jax_compilation_cache_dir", os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+# programs in one process, which dominates suite wall time; a warm cache
+# removes almost all in-process compilation on repeat runs.  Threshold 0:
+# even millisecond compiles are worth caching here.
+#
+# ROOT CAUSE of the historical "full-tree segfault" (resolved round 5;
+# repro tools/full_tree_cold.sh, stack in PERF.md): all drivers shared
+# ONE .jax_cache dir, examples/util.default_ctx enabled it mid-tree
+# unconditionally, and deserializing executables written under the axon
+# processes' different XLA CPU target config (+prefer-no-scatter pseudo-
+# features) SIGSEGVs.  The cache is now per backend and every enabler
+# honors CYLON_TEST_NO_COMPILE_CACHE — see
+# cylon_tpu/utils/compile_cache.py.
+from cylon_tpu.utils.compile_cache import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache(min_compile_secs=0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

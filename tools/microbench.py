@@ -23,9 +23,9 @@ sys.path.insert(0, REPO_ROOT)
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(REPO_ROOT, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+from cylon_tpu.utils.compile_cache import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 26)
 REPS = 3

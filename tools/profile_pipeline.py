@@ -16,11 +16,10 @@ import jax.numpy as jnp
 import numpy as np
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(REPO_ROOT, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-
 sys.path.insert(0, REPO_ROOT)
+from cylon_tpu.utils.compile_cache import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
 import cylon_tpu  # noqa: F401,E402
 from cylon_tpu import column as colmod
 from cylon_tpu.config import JoinType

@@ -21,12 +21,11 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    try:  # persistent compile cache: don't re-pay ~30s/kernel per window
-        jax.config.update("jax_compilation_cache_dir", os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:
-        pass
+    # persistent compile cache (per-backend dir — utils/compile_cache.py):
+    # don't re-pay ~30s/kernel per window
+    from cylon_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
 
     results = {"platform": None, "kernels": {}, "ok": False}
 
