@@ -156,3 +156,45 @@ def test_run_extents_pallas_scan_agrees(rng, monkeypatch):
     s1, c1 = segments.run_extents(*args)
     np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
     np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+
+
+@pytest.mark.slow
+def test_segmented_scan_randomized_soak(rng):
+    """Randomized soak across sizes, densities, ops, dtypes, and block
+    widths — the confidence bar for ever making the Pallas scans a
+    default (mirrors the chunked-engine soak discipline)."""
+    import jax
+
+    for case in range(30):
+        n = int(rng.integers(1, 200000))
+        dens = float(rng.uniform(0.0005, 0.3))
+        op = ["sum", "min", "max"][case % 3]
+        dt = [np.float32, np.int32, np.uint32][(case // 3) % 3]
+        bl = int(rng.choice([128, 256, 1024]))
+        x = (rng.random(n) * 100).astype(dt)
+        r = rng.random(n) < dens
+        if n:
+            r[0] = True
+        got = np.asarray(pallas_scan.segmented_scan(
+            jnp.asarray(x), jnp.asarray(r), op, interpret=True,
+            block_lanes=bl))
+        exp = _golden(x, r, op).astype(dt)
+        if dt == np.float32 and op == "sum":
+            np.testing.assert_allclose(got, exp, rtol=1e-4,
+                                       err_msg=f"case {case} n={n}")
+        else:
+            np.testing.assert_array_equal(got, exp, f"case {case} n={n}")
+        # plain scan against lax on the same draw
+        xi = x.astype(np.int32)
+        rev = bool(case % 2)
+        got2 = np.asarray(pallas_scan.scan_1d(
+            jnp.asarray(xi), op, reverse=rev, interpret=True,
+            block_lanes=bl))
+        f = {"sum": None, "min": jax.lax.cummin, "max": jax.lax.cummax}[op]
+        if op == "sum":
+            e = jnp.cumsum(jnp.flip(jnp.asarray(xi))) if rev \
+                else jnp.cumsum(jnp.asarray(xi))
+            exp2 = np.asarray(jnp.flip(e) if rev else e)
+        else:
+            exp2 = np.asarray(f(jnp.asarray(xi), reverse=rev))
+        np.testing.assert_array_equal(got2, exp2, f"plain case {case} n={n}")
