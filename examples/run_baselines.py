@@ -11,19 +11,20 @@ from __future__ import annotations
 import os
 import sys
 
-from . import etl_to_flax, join_csv, shuffle_bench, tpch_q1, tpch_q5
+from . import (etl_to_flax, join_csv, shuffle_bench, tpch_q1, tpch_q3,
+               tpch_q5, tpch_q6)
 from .util import log
 
 PRESETS = {
     "small": dict(join_rows=100_000, q1_sf=0.05, shuffle_rows=1 << 20,
-                  q5_sf=0.01, events=100_000),
+                  q5_sf=0.01, q3_sf=0.01, q6_sf=0.05, events=100_000),
     # full: BASELINE stated-scale single-chip runs.  Q5 goes through the
     # out-of-core chain (config 4 states SF-100 on a v5e-16 POD; SF-10 is
     # the per-chip-honest equivalent on the one available chip, and
     # CYLON_Q5_SF raises it when a larger window exists).
     "full": dict(join_rows=5_000_000, q1_sf=1.0, shuffle_rows=1 << 27,
                  q5_sf=float(os.environ.get("CYLON_Q5_SF", "10")),
-                 events=2_000_000),
+                 q3_sf=0.5, q6_sf=1.0, events=2_000_000),
 }
 
 
@@ -45,6 +46,8 @@ def main() -> int:
             int(os.environ.get("CYLON_SHUFFLE_OOC_ROWS", str(1 << 30)))))
             if preset == "full" else (lambda: shuffle_bench.run_ooc(
                 1 << 18, world=4, passes=4))),
+        ("tpch_q3", lambda: tpch_q3.run(p["q3_sf"])),
+        ("tpch_q6", lambda: tpch_q6.run(p["q6_sf"])),
         ("tpch_q5", q5),
         ("etl_to_flax", lambda: etl_to_flax.run(p["events"])),
     ]:

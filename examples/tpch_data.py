@@ -31,6 +31,10 @@ REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
 DATE_LO, DATE_HI = 0, 2556
 Q1_CUTOFF = 2190  # ~1998-09-02 (1998-12-01 minus 90 days)
 Q5_LO, Q5_HI = 730, 1095  # orderdate in [1994-01-01, 1995-01-01)
+Q3_DATE = 1168             # 1995-03-15 (Q3's order/ship cutoff)
+Q6_LO, Q6_HI = 730, 1095   # shipdate in [1994-01-01, 1995-01-01)
+MKTSEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+               "MACHINERY"]
 
 
 def lineitem(sf: float, rng: np.random.Generator, *, q5_keys: bool = False,
@@ -55,22 +59,30 @@ def lineitem(sf: float, rng: np.random.Generator, *, q5_keys: bool = False,
     return d
 
 
-def orders(sf: float, rng: np.random.Generator):
+def orders(sf: float, rng: np.random.Generator, *, q3_cols: bool = False):
     n = int(ORDERS_ROWS_PER_SF * sf)
-    return {
+    d = {
         "o_orderkey": np.arange(n, dtype=np.int32),
         "o_custkey": rng.integers(0, int(CUSTOMER_ROWS_PER_SF * sf),
                                   n).astype(np.int32),
         "o_orderdate": rng.integers(DATE_LO, DATE_HI, n).astype(np.int32),
     }
+    if q3_cols:  # opt-in, see customer()
+        d["o_shippriority"] = np.zeros(n, np.int32)  # spec: constant 0
+    return d
 
 
-def customer(sf: float, rng: np.random.Generator):
+def customer(sf: float, rng: np.random.Generator, *, q3_cols: bool = False):
     n = int(CUSTOMER_ROWS_PER_SF * sf)
-    return {
+    d = {
         "c_custkey": np.arange(n, dtype=np.int32),
         "c_nationkey": rng.integers(0, len(NATIONS), n).astype(np.int32),
     }
+    if q3_cols:  # opt-in: Q1/Q5 payload widths must stay comparable
+        # across rounds (spec: ~1/5 of customers per segment)
+        d["c_mktsegment"] = rng.integers(0, len(MKTSEGMENTS),
+                                         n).astype(np.int32)
+    return d
 
 
 def supplier(sf: float, rng: np.random.Generator):

@@ -21,6 +21,20 @@ def test_tpch_q1_example():
     assert rec["groups"] == 6
 
 
+def test_tpch_q3_example():
+    from examples import tpch_q3
+
+    rec = tpch_q3.run(sf=0.004)  # check=True inside: top-10 vs pandas
+    assert rec["top"] >= 1
+
+
+def test_tpch_q6_example():
+    from examples import tpch_q6
+
+    rec = tpch_q6.run(sf=0.02)  # check=True inside: revenue vs pandas
+    assert rec["revenue"] > 0
+
+
 def test_tpch_q5_example():
     from examples import tpch_q5
 
