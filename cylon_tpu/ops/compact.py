@@ -133,14 +133,42 @@ def count_leq_dense(sorted_vals: jax.Array, num_queries: int) -> jax.Array:
     return p[:num_queries] - jnp.arange(num_queries, dtype=jnp.int32)
 
 
+def invperm_mode() -> str:
+    """Sub-realization of sort-mode ``inverse_permute``: ``"sort"``
+    (default — one multi-operand sort carries every field) or
+    ``"gather"`` (one 2-operand sort builds the inverse index once, then
+    one bandwidth-linear ``take`` per field).  The trade: a k-field
+    multi-operand sort moves (k+1) operands through every sort pass,
+    while the gather realization pays the sort passes once on 8 B/row
+    and k linear gathers — the crossover is a hardware question
+    (microbench + profiler A/B arms; CYLON_TPU_INVPERM overrides).
+    Only meaningful when permute_mode() == "sort"."""
+    mode = os.environ.get("CYLON_TPU_INVPERM", "sort")
+    return mode if mode in ("sort", "gather") else "sort"
+
+
 def inverse_permute(perm: jax.Array, *fields: jax.Array) -> Tuple[jax.Array, ...]:
     """``out[perm[i]] = fields[..][i]`` for each field — the inverse-
     permutation apply (``perm`` must be a permutation of [0, n)).
 
     scatter mode: one scatter per field.  sort mode: ONE multi-operand
     ``lax.sort`` keyed on ``perm`` (unique keys, unstable OK) carries all
-    fields to their destinations in a single fused pass."""
+    fields to their destinations in a single fused pass — or, under
+    ``invperm_mode() == "gather"``, one 2-operand sort computes
+    ``inv = argsort(perm)`` and each field is one linear gather
+    ``take(f, inv)`` (equivalent because out[j] = f[inv[j]])."""
     if permute_mode() == "sort":
+        if invperm_mode() == "gather":
+            cap = perm.shape[0]
+            iota = jnp.arange(cap, dtype=jnp.int32)  # payload: no cast back
+            _, inv = jax.lax.sort((perm.astype(jnp.uint32), iota),
+                                  num_keys=1, is_stable=False)
+            # inv is an argsort of a permutation — provably in bounds and
+            # unique; the default fill mode would add a clamp+select per
+            # element inside the very A/B this realization exists to win
+            return tuple(f.at[inv].get(mode="promise_in_bounds",
+                                       unique_indices=True)
+                         for f in fields)
         sorted_ops = jax.lax.sort((perm.astype(jnp.uint32),) + tuple(fields),
                                   num_keys=1, is_stable=False)
         return tuple(sorted_ops[1:])

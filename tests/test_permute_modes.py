@@ -74,6 +74,12 @@ def test_inverse_permute_agree(monkeypatch):
     ref = np.empty(n, np.int32)
     ref[np.asarray(perm)] = np.asarray(f1)
     np.testing.assert_array_equal(a1, ref)
+    # third realization: sort-family gather (argsort once + take per field)
+    monkeypatch.setenv("CYLON_TPU_PERMUTE", "sort")
+    monkeypatch.setenv("CYLON_TPU_INVPERM", "gather")
+    g1, g2 = run()
+    np.testing.assert_array_equal(g1, ref)
+    np.testing.assert_array_equal(g2, a2)
 
 
 @pytest.mark.parametrize("jt", [JoinType.INNER, JoinType.LEFT,

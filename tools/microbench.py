@@ -112,6 +112,23 @@ for mode in ("scatter", "sort"):
     timed(f"inverse_permute 2-field ({mode})",
           lambda p, x, y: compact.inverse_permute(p, x, y), perm,
           a.astype(jnp.int32), b.astype(jnp.int32), traffic_bytes=6 * B4)
+# sort-family gather realization of inverse_permute (CYLON_TPU_INVPERM):
+# one 2-op sort + k linear takes vs the (k+1)-operand sort — measured at
+# 2 and 4 fields so the crossover (if any) is visible
+# (2-field sort/sort is already timed above as "inverse_permute 2-field
+# (sort)" — not repeated)
+os.environ["CYLON_TPU_PERMUTE"] = "sort"
+timed("inverse_permute 4-field (sort/sort)",
+      lambda p, x, y: compact.inverse_permute(p, x, y, x, y), perm,
+      a.astype(jnp.int32), b.astype(jnp.int32), traffic_bytes=10 * B4)
+os.environ["CYLON_TPU_INVPERM"] = "gather"
+timed("inverse_permute 2-field (sort/gather)",
+      lambda p, x, y: compact.inverse_permute(p, x, y), perm,
+      a.astype(jnp.int32), b.astype(jnp.int32), traffic_bytes=6 * B4)
+timed("inverse_permute 4-field (sort/gather)",
+      lambda p, x, y: compact.inverse_permute(p, x, y, x, y), perm,
+      a.astype(jnp.int32), b.astype(jnp.int32), traffic_bytes=10 * B4)
+os.environ.pop("CYLON_TPU_INVPERM", None)
 os.environ.pop("CYLON_TPU_PERMUTE", None)
 timed("count_leq_dense", lambda v: compact.count_leq_dense(v, N),
       jnp.sort(a.astype(jnp.int32) % N), traffic_bytes=4 * B4)

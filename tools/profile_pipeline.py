@@ -191,9 +191,12 @@ timed(f"back-map + partition ({compact.permute_mode()})", stage_back,
       traffic_bytes=N2 * 4 * (3 * 2 + 2 * 2 + 3))
 
 
-def _permute_variant(label, mode):
-    """Re-time the back-map stage under the other permute realization."""
-    os.environ["CYLON_TPU_PERMUTE"] = mode
+def _permute_variant(label, env):
+    """Re-time the back-map stage under another permute/invperm
+    realization (``env``: the CYLON_TPU_* vars to pin; read at trace
+    time, so the stage jits fresh per variant)."""
+    for k, v in env.items():
+        os.environ[k] = v
 
     @jax.jit
     def stage(perm, lo_sorted, matches_sorted):
@@ -208,11 +211,18 @@ def _permute_variant(label, mode):
         print(f"{label:34s} FAILED: {type(e).__name__}: {str(e)[:200]}",
               flush=True)
     finally:
-        os.environ.pop("CYLON_TPU_PERMUTE", None)
+        for k in env:
+            os.environ.pop(k, None)
 
 
 other = "scatter" if compact.permute_mode() == "sort" else "sort"
-_permute_variant(f"back-map + partition ({other})", other)
+_permute_variant(f"back-map + partition ({other})",
+                 {"CYLON_TPU_PERMUTE": other})
+# sort-family gather realization of the back-map's inverse_permute
+# (CYLON_TPU_INVPERM=gather): one 2-op argsort + linear takes vs the
+# multi-operand carry sort
+_permute_variant("back-map + partition (sort/gather)",
+                 {"CYLON_TPU_PERMUTE": "sort", "CYLON_TPU_INVPERM": "gather"})
 
 # -- full join_gather ------------------------------------------------------
 # same SEED and data recipe as bench.py, so its verified join-count cache
