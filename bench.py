@@ -57,7 +57,7 @@ def _log(msg: str) -> None:
 # artifact assembly can never disagree about what an absent key means
 # (round-4 advice finding 3).
 _LEGACY_DEFAULTS = {"segsum": "scatter", "permute": "scatter",
-                    "scan": "xla"}
+                    "scan": "xla", "invperm": "sort"}
 
 
 def _code_fingerprint() -> str:
@@ -361,7 +361,8 @@ def _worker(backend: str, skip: int = 0) -> int:
                 "sort_mode": os.environ.get("CYLON_TPU_SORT", "cmp"),
                 "segsum": segsum,
                 "scan": scan,
-                "permute": _compact.permute_mode()}
+                "permute": _compact.permute_mode(),
+                "invperm": _compact.invperm_mode()}
         if passes > 1:
             frag["passes"] = passes
             if value_cold is not None:
@@ -524,6 +525,7 @@ class _Bench:
             "segsum": r.get("segsum", _LEGACY_DEFAULTS["segsum"]),
             "scan": r.get("scan", _LEGACY_DEFAULTS["scan"]),
             "permute": r.get("permute", _LEGACY_DEFAULTS["permute"]),
+            "invperm": r.get("invperm", _LEGACY_DEFAULTS["invperm"]),
             "source": source,
         }
         if r.get("stale_code"):
@@ -569,6 +571,7 @@ class _Bench:
                 and r.get("sort_mode", "cmp") == "cmp" \
                 and r.get("permute", _LEGACY_DEFAULTS["permute"]) == "sort" \
                 and r.get("scan", _LEGACY_DEFAULTS["scan"]) == "xla" \
+                and r.get("invperm", _LEGACY_DEFAULTS["invperm"]) == "sort" \
                 and not r.get("passes") \
                 and beats_cur:
             # the seed is the best default-config TPU number for the

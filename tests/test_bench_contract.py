@@ -86,7 +86,9 @@ def test_experiment_fragments_never_seed_cache(tmp_path, monkeypatch):
             "sort_mode": "cmp", "permute": "sort"}
     for exp in ({"algo": "hash", "segsum": "prefix", "scan": "xla"},
                 {"algo": "sort", "segsum": "pallas", "scan": "xla"},
-                {"algo": "sort", "segsum": "prefix", "scan": "pallas"}):
+                {"algo": "sort", "segsum": "prefix", "scan": "pallas"},
+                {"algo": "sort", "segsum": "prefix", "scan": "xla",
+                 "invperm": "gather"}):
         b.accept(dict(base, **exp), source="live")
         assert json.loads(cache.read_text()).get("tpu") is None, exp
     b.accept(dict(base, algo="sort", segsum="prefix", scan="xla"),

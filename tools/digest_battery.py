@@ -32,7 +32,7 @@ def digest(d: str) -> None:
             print(f"  {os.path.basename(name):24s} {r['error']}")
             continue
         extras = "".join(
-            f" {k}={r[k]}" for k in ("algo", "sort_mode", "segsum", "permute",
+            f" {k}={r[k]}" for k in ("algo", "sort_mode", "segsum", "scan", "invperm", "permute",
                                      "passes", "partial", "device_kind")
             if r.get(k) is not None)
         print(f"  {os.path.basename(name):24s} {r.get('value', 0):>14,.0f} "
