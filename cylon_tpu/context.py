@@ -101,8 +101,16 @@ class CylonContext:
             cfg = config if isinstance(config, TPUConfig) else TPUConfig()
             if cfg.num_processes is not None and cfg.num_processes > 1:
                 # the MPI_Init moment: join the global runtime before any
-                # backend initializes, so jax.devices() spans every host
-                if not jax.distributed.is_initialized():
+                # backend initializes, so jax.devices() spans every host.
+                # jax <= 0.4.x has no jax.distributed.is_initialized; fall
+                # back to the client handle initialize() populates
+                if hasattr(jax.distributed, "is_initialized"):
+                    _initialized = jax.distributed.is_initialized()
+                else:
+                    from jax._src import distributed as _dist
+
+                    _initialized = _dist.global_state.client is not None
+                if not _initialized:
                     jax.distributed.initialize(
                         coordinator_address=cfg.coordinator_address,
                         num_processes=cfg.num_processes,
@@ -155,6 +163,40 @@ class CylonContext:
     def GetConfig(self, key: str, default: str = "") -> str:
         return self._config.get(key, default)
 
+    # -- resilience --------------------------------------------------------
+    def retry_policy(self):
+        """Transient-failure retry policy for operations on this context.
+        Unset contexts re-read the env knobs (CYLON_TPU_RETRY_*) on every
+        call so tests and long-lived processes see live values; an
+        explicit `set_retry_policy` pins one."""
+        policy = getattr(self, "_retry_policy", None)
+        if policy is not None:
+            return policy
+        from .resilience import RetryPolicy
+
+        return RetryPolicy.from_env()
+
+    def set_retry_policy(self, policy) -> None:
+        self._retry_policy = policy
+
+    def collective_retry_policy(self):
+        """Policy for retrying a whole SPMD collective (shuffle exchange,
+        distributed per-pass join).  Safe only when ONE process drives
+        every mesh device: re-entering the collective from a single host
+        of a multi-process mesh would issue a program the peers — blocked
+        inside or already past the original — never join, desyncing the
+        mesh.  Multi-process runs therefore get a no-retry policy and the
+        failure surfaces immediately."""
+        from .resilience import RetryPolicy
+
+        import jax
+
+        if self.distributed and jax.process_count() > 1:
+            base = self.retry_policy()
+            return RetryPolicy(max_retries=0, base_s=base.base_s,
+                               max_s=base.max_s)
+        return self.retry_policy()
+
     # -- sequence / barrier / finalize -------------------------------------
     def GetNextSequence(self) -> int:
         # XLA orders collectives by program order; kept for API parity only
@@ -175,8 +217,10 @@ class CylonContext:
 
         cached = getattr(self, "_barrier_fn", None)
         if cached is None:
+            from .utils import shard_map
+
             mesh = self.mesh
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map(
                 lambda v: jax.lax.psum(v, PARTITION_AXIS),
                 mesh=mesh, in_specs=P(PARTITION_AXIS), out_specs=P()))
             x = jax.device_put(

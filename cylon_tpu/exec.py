@@ -44,11 +44,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import column as colmod
+from . import resilience
 from .config import JoinConfig, JoinType
 from .ops import groupby as groupby_mod
 from .ops import join as join_mod
 from .ops.groupby import AggOp
-from .status import Code, CylonError
+from .status import Code, CylonError, Status
 from .utils import pow2ceil
 
 
@@ -69,7 +70,9 @@ def _as_host_frame(obj) -> Tuple[List[str], Dict[str, np.ndarray]]:
         return list(obj.names), obj.to_numpy()
     try:
         import pandas as pd
-    except Exception:
+    except ImportError:
+        # only a MISSING pandas disables DataFrame support; a broken
+        # install must surface, not silently reject every DataFrame
         pd = None
     if pd is not None and isinstance(obj, pd.DataFrame):
         return ([str(c) for c in obj.columns],
@@ -157,11 +160,18 @@ def _row_hash_u64(a: np.ndarray) -> np.ndarray:
     return _mix_u64(_key_prefix_u64(a))
 
 
-def _hash_pass_ids(key_cols: Sequence[np.ndarray], passes: int) -> np.ndarray:
+def _hash_u64_cols(key_cols: Sequence[np.ndarray]) -> np.ndarray:
+    """Combined full-content uint64 hash of a key-column tuple — the raw
+    value behind hash-mode pass ids, also used by `_RefinablePlan` to
+    subdivide passes (h % 2P refines h % P)."""
     h = _row_hash_u64(key_cols[0])
     for col in key_cols[1:]:
         h = _mix_u64(h ^ _row_hash_u64(col))
-    return (h % np.uint64(passes)).astype(np.int64)
+    return h
+
+
+def _hash_pass_ids(key_cols: Sequence[np.ndarray], passes: int) -> np.ndarray:
+    return (_hash_u64_cols(key_cols) % np.uint64(passes)).astype(np.int64)
 
 
 _PLAN_SAMPLE = 1 << 20
@@ -452,32 +462,308 @@ def _null_mask(a: np.ndarray):
 PASS_PROGRESS_HOOK = None
 
 
-def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0):
-    """Shared streaming loop: compile on a zero-count chunk (same shapes,
-    no duplicate host pass over the largest chunk), then double-buffer —
-    pass p dispatches async while pass p+1's host compression + upload
-    overlap it (CYLON_TPU_PREFETCH=0 reverts to strictly serial)."""
-    warm = empty_chunk()
-    jax.block_until_ready(prog(*warm))
-    del warm
-    t_plan = time.perf_counter() - t0
-    prefetch = os.environ.get("CYLON_TPU_PREFETCH", "1") != "0"
+def _notify_progress(done, n_passes, total, secs) -> None:
+    """Invoke PASS_PROGRESS_HOOK non-fatally: a broken progress observer
+    must never kill a 64-pass run — it is warned about once and disabled
+    for the rest of the process."""
+    global PASS_PROGRESS_HOOK
+    hook = PASS_PROGRESS_HOOK
+    if hook is None:
+        return
+    try:
+        hook(done, n_passes, total, secs)
+    except Exception as e:
+        import warnings
+
+        PASS_PROGRESS_HOOK = None
+        warnings.warn(f"PASS_PROGRESS_HOOK raised {type(e).__name__}: {e}; "
+                      f"progress reporting disabled", RuntimeWarning)
+
+
+class _RefinablePlan:
+    """Key-domain pass plan that can subdivide its REMAINING parts when a
+    pass exceeds device memory.
+
+    Level-``l`` pass ids are ``pid0 + P0 * (q % 2**l)`` over ``P0 * 2**l``
+    parts, so part ``p`` at level ``l`` splits into ``{p, p + P0*2**l}``
+    at level ``l+1`` — completed parts keep their frames, only unfinished
+    key-domain parts re-run at the finer granularity.
+
+    ``q`` (lazy — costs one host hash pass, paid only on the first OOM):
+    hash plans use ``q = h // P0`` so the refined id equals ``h % (P0 *
+    2**l)``, the splitmix64 partitioner's natural modulus refinement;
+    range plans hash the first key column's order-preserving prefix, so
+    the refined id stays a function of the FIRST key alone and
+    `_passes_final`'s range-mode finality reasoning survives refinement.
+    Either way equal keys share ``q`` on both sides, so refined parts
+    still partition the key domain and every per-pass result stays exact.
+    """
+
+    def __init__(self, pid_l, pid_r, n_passes: int, mode_used: str,
+                 keys_l, keys_r):
+        self.pid0_l = np.asarray(pid_l)
+        self.pid0_r = np.asarray(pid_r)
+        self.p0 = int(n_passes)
+        self.mode = mode_used
+        self._keys_l = keys_l
+        self._keys_r = keys_r
+        self._q = None
+        self._pid_cache = None  # (level, (pid_l, pid_r)) — one level only
+
+    def _q_for(self, keys, pid0) -> np.ndarray:
+        if not keys or len(keys[0]) == 0:
+            return np.zeros(len(pid0), np.uint64)
+        if self.mode == "hash":
+            return _hash_u64_cols(keys) // np.uint64(self.p0)
+        return _mix_u64(_key_prefix_u64(keys[0]))
+
+    def part_count(self, level: int) -> int:
+        return self.p0 << level
+
+    def pids(self, level: int):
+        """(pass_id_l, pass_id_r) int arrays at refinement ``level``.
+        The last computed level is memoized: during one OOM recovery the
+        redistribution checks and the rebuild all ask for the same level,
+        and recomputing would materialize fresh full-table arrays at the
+        exact moment the host is under memory pressure."""
+        if level == 0:
+            return self.pid0_l, self.pid0_r
+        if self._pid_cache is not None and self._pid_cache[0] == level:
+            return self._pid_cache[1]
+        if self._q is None:
+            self._q = (self._q_for(self._keys_l, self.pid0_l),
+                       self._q_for(self._keys_r, self.pid0_r))
+        mask = np.uint64((1 << level) - 1)
+        ql, qr = self._q
+        pid_l = (self.pid0_l.astype(np.int64)
+                 + self.p0 * (ql & mask).astype(np.int64))
+        pid_r = (self.pid0_r.astype(np.int64)
+                 + self.p0 * (qr & mask).astype(np.int64))
+        self._pid_cache = (level, (pid_l, pid_r))
+        return pid_l, pid_r
+
+    def split(self, parts: List[int], level: int) -> List[int]:
+        """Subdivide each of ``parts`` (ids at ``level``) into its two
+        children at ``level + 1``, keeping sibling adjacency."""
+        c = self.part_count(level)
+        return [s for p in parts for s in (p, p + c)]
+
+    def max_part_rows(self, parts: List[int], level: int) -> Tuple[int, int]:
+        """(max left rows, max right rows) over ``parts`` at ``level`` —
+        the quantities that size a rebuild's chunk capacities."""
+        if not parts:
+            return 0, 0
+        pid_l, pid_r = self.pids(level)
+        c = self.part_count(level)
+        sel = np.asarray(parts, np.int64)
+        c_l = np.bincount(pid_l, minlength=c)[sel]
+        c_r = np.bincount(pid_r, minlength=c)[sel]
+        return int(c_l.max(initial=0)), int(c_r.max(initial=0))
+
+    def parts_redistributing(self, parts: List[int], level: int):
+        """Bool array aligned with ``parts``: True where splitting moves
+        that part's rows between its two children on either side.  A
+        False part is a key-domain atom (one hot key, or one shared
+        8-byte prefix in range mode): its rows all land in one child of
+        its old size, so no refinement depth can shrink it."""
+        sel = np.asarray(parts, np.int64)
+        out = np.zeros(len(sel), bool)
+        if not parts:
+            return out
+        c0 = self.part_count(level)
+        c1 = self.part_count(level + 1)
+        for pid in self.pids(level + 1):
+            if len(pid) == 0:
+                continue
+            cnt = np.bincount(pid, minlength=c1)
+            out |= (cnt[sel] > 0) & (cnt[sel + c0] > 0)
+        return out
+
+
+def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
+                        prefetch=True, progress=True):
+    """The resilient streaming loop: checkpointed host frames + adaptive
+    pass-splitting + bounded transient retry.
+
+    ``make_exec(parts, level)`` builds one level's execution — builders
+    and capacities sized over the REMAINING ``parts`` only, one compiled
+    program — returning ``(chunk, prog, fetch)``.  Completed parts' host
+    frames are kept across rebuilds, so recovery RESUMES the stream at
+    the failed part instead of restarting it.
+
+    Failure handling, by classified code (`Status.from_exception`):
+    - `Code.OutOfMemory` — every remaining part splits in two (``plan``)
+      and the level's execution is rebuilt at roughly half the chunk
+      capacity; bounded by ``CYLON_TPU_MAX_OOM_SPLITS``, after which a
+      `CylonError(Code.OutOfMemory)` is raised.  ``plan=None`` (callers
+      whose pass order is not refinable, e.g. the global sort) disables
+      splitting and propagates the failure.
+    - `Code.ExecutionError` (transient comm/deadline) — the failing part
+      retries in place under ``policy``'s exponential backoff.
+    - anything else — propagates unchanged (a TypeError stays a bug).
+
+    Returns ``(t_plan, t_run0, frames, total)`` like the old fixed loop.
+    """
+    policy = policy or resilience.RetryPolicy.from_env()
+    stats = stats if stats is not None else {}
+    max_splits = resilience.max_oom_splits() if plan is not None else 0
+    n_parts0 = plan.part_count(0) if plan is not None else None
+    prefetch = prefetch and os.environ.get("CYLON_TPU_PREFETCH", "1") != "0"
+
+    frames: List[Dict[str, np.ndarray]] = []
+    total = 0
+    remaining = list(range(n_parts0)) if n_parts0 is not None else None
+    level = 0
+    part_retries = 0  # transient retries of the current head part
+    atom_watch: set = set()  # child ids of a head atom already split once
+    t_plan = None
     t_run0 = time.perf_counter()
-    frames, total = [], 0
-    nxt = chunk(0) if prefetch else None
-    for p in range(n_passes):
-        cur = nxt if prefetch else chunk(p)
-        fut = prog(*cur)
-        nxt = chunk(p + 1) if prefetch and p + 1 < n_passes else None
-        frame, n = fetch(fut)
-        total += n
-        frames.append(frame)
-        del cur, fut
-        if PASS_PROGRESS_HOOK is not None:
-            PASS_PROGRESS_HOOK(p + 1, n_passes, total,
-                               time.perf_counter() - t_run0)
-    del nxt
+    exec_cache: Dict[int, tuple] = {}
+
+    def recover(e: Exception) -> None:
+        """Adjust (remaining, level) for a recoverable failure or raise."""
+        nonlocal remaining, level, part_retries
+        st = Status.from_exception(e)
+        if st.code == Code.OutOfMemory and plan is not None:
+            if level >= max_splits:
+                raise CylonError(
+                    Code.OutOfMemory,
+                    f"pass still exceeds device memory after {level} "
+                    f"pass-doublings (CYLON_TPU_MAX_OOM_SPLITS="
+                    f"{max_splits}): {st.msg}") from e
+            # progress check: a split that moves no rows rebuilds an
+            # identically-sized program that must OOM again — fail fast
+            # instead of burning the whole split budget on no-ops
+            moved = plan.parts_redistributing(remaining, level)
+            if not moved.any():
+                atom_l, atom_r = plan.max_part_rows(remaining, level)
+                raise CylonError(
+                    Code.OutOfMemory,
+                    f"splitting cannot shrink the failing pass: the "
+                    f"remaining parts (largest {atom_l}+{atom_r} rows) "
+                    f"are key-domain atoms (single hot key or shared "
+                    f"range prefix): {st.msg}") from e
+            # the FAILING head part may be an atom even when later parts
+            # split: allow it ONE split (a smaller output capacity from
+            # the other parts can heal an output-driven OOM), then stop.
+            # The atom is tracked by id lineage — a part's first child
+            # keeps its id, the second gets id + part_count — so an empty
+            # sibling completing in between cannot hide the repeat OOM.
+            if not moved[0]:
+                head = remaining[0]
+                if head in atom_watch:
+                    atom_l, atom_r = plan.max_part_rows(remaining[:1],
+                                                        level)
+                    raise CylonError(
+                        Code.OutOfMemory,
+                        f"splitting cannot shrink the failing pass: its "
+                        f"{atom_l}+{atom_r} rows are one key-domain atom "
+                        f"(single hot key or shared range prefix): "
+                        f"{st.msg}") from e
+                atom_watch.clear()
+                atom_watch.update((head, head + plan.part_count(level)))
+            else:
+                atom_watch.clear()
+            remaining = plan.split(remaining, level)
+            level += 1
+            part_retries = 0
+            # levels are never revisited after a split: free the coarser
+            # levels' builders (each holds presorted host copies of both
+            # tables) instead of accumulating one copy per refinement
+            # while recovering from memory pressure
+            exec_cache.clear()
+            stats["oom_splits"] = stats.get("oom_splits", 0) + 1
+            return
+        if st.code in resilience.RETRYABLE_CODES:
+            if part_retries >= policy.max_retries:
+                raise CylonError(
+                    st.code,
+                    f"pass retries exhausted after {part_retries + 1} "
+                    f"attempts: {st.msg}") from e
+            d = policy.delay(part_retries)
+            part_retries += 1
+            stats["retries"] = stats.get("retries", 0) + 1
+            if d > 0:
+                policy.sleep(d)
+            return
+        raise e
+
+    while remaining is None or remaining:
+        try:
+            ex = exec_cache.get(level)
+            if ex is None:
+                ex = make_exec(remaining, level)
+                exec_cache[level] = ex
+        except Exception as e:
+            recover(e)
+            continue
+        chunk, prog, fetch = ex
+        if remaining is None:  # plan-less callers stream positions 0..n-1
+            remaining = list(range(stats["passes"]))
+        if t_plan is None:
+            t_plan = time.perf_counter() - t0
+            t_run0 = time.perf_counter()
+        cursor = 0
+        cur = fut = nxt = None
+        try:
+            nxt = chunk(remaining[0]) if prefetch else None
+            while cursor < len(remaining):
+                resilience.fault_point("pass_dispatch")
+                cur = nxt if nxt is not None else chunk(remaining[cursor])
+                fut = prog(*cur)                       # async dispatch
+                nxt = (chunk(remaining[cursor + 1])
+                       if prefetch and cursor + 1 < len(remaining) else None)
+                resilience.fault_point("host_fetch")
+                frame, n = fetch(fut)      # blocks; device errors land here
+                total += n
+                frames.append(frame)
+                cursor += 1
+                part_retries = 0
+                stats["parts_run"] = stats.get("parts_run", 0) + 1
+                cur = fut = None
+                if progress:
+                    _notify_progress(
+                        len(frames), len(frames) + len(remaining) - cursor,
+                        total, time.perf_counter() - t_run0)
+            remaining = []
+        except Exception as e:
+            # drop the failed pass's device buffers BEFORE re-planning:
+            # this frame stays alive through recover()/make_exec(), and a
+            # rebuild warmed while the dead full-size buffers are still
+            # resident would re-OOM and burn a split for nothing.  The
+            # level's program/builder locals go too — their closures hold
+            # full presorted host copies of both sides, and keeping them
+            # referenced across make_exec would double host memory at the
+            # exact moment we're recovering from pressure
+            cur = fut = nxt = None
+            chunk = prog = fetch = ex = None
+            remaining = remaining[cursor:]  # completed frames are kept
+            recover(e)
+    if t_plan is None:
+        t_plan = time.perf_counter() - t0
     return t_plan, t_run0, frames, total
+
+
+def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0, *,
+                policy=None, stats=None):
+    """Streaming loop over positional passes 0..n-1 with transient-retry
+    resilience (no OOM splitting: callers on this entry — the global sort
+    — emit passes in an order a hash subdivision would scramble).
+    Compiles on a zero-count chunk (same shapes, no duplicate host pass
+    over the largest chunk), then double-buffers — pass p dispatches
+    async while pass p+1's host compression + upload overlap it
+    (CYLON_TPU_PREFETCH=0 reverts to strictly serial)."""
+    stats = stats if stats is not None else {}
+    stats["passes"] = n_passes
+
+    def make_exec(_parts, _level):
+        warm = empty_chunk()
+        jax.block_until_ready(prog(*warm))
+        del warm
+        return chunk, prog, fetch
+
+    return _stream_recoverable(make_exec, None, t0, policy=policy,
+                               stats=stats)
 
 
 def _concat_host(frames: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -494,7 +780,8 @@ def _concat_host(frames: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
 
 def chunked_join(left, right, *, on=None, left_on=None, right_on=None,
                  how: str = "inner", passes: int = 4, algo: str = "sort",
-                 mode: str = "auto", ctx=None, prefetch: bool = True):
+                 mode: str = "auto", ctx=None, prefetch: bool = True,
+                 left_prefix: str = "l_", right_prefix: str = "r_"):
     """Out-of-core join over host frames (pandas/dict/Table): the key
     domain is split into ``passes`` parts, each part joined on device by
     one shared compiled program, outputs concatenated on the host.  All
@@ -504,7 +791,9 @@ def chunked_join(left, right, *, on=None, left_on=None, right_on=None,
     return _chunked_engine(left, right, on=on, left_on=left_on,
                            right_on=right_on, how=how, group_by=None,
                            agg=None, passes=passes, algo=algo, ddof=0,
-                           mode=mode, ctx=ctx, prefetch=prefetch)
+                           mode=mode, ctx=ctx, prefetch=prefetch,
+                           left_prefix=left_prefix,
+                           right_prefix=right_prefix)
 
 
 def chunked_join_groupby_tables(left, right, *, on=None, left_on=None,
@@ -531,7 +820,8 @@ def chunked_join_groupby_tables(left, right, *, on=None, left_on=None,
 
 
 def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
-                    agg, passes, algo, ddof, mode, ctx, prefetch):
+                    agg, passes, algo, ddof, mode, ctx, prefetch,
+                    left_prefix: str = "l_", right_prefix: str = "r_"):
     t_plan0 = time.perf_counter()
     names_l, arrs_l = _as_host_frame(left)
     names_r, arrs_r = _as_host_frame(right)
@@ -540,7 +830,8 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
     if len(lon) != len(ron):
         raise CylonError(Code.Invalid, "left_on/right_on length mismatch")
     _check_key_dtypes(arrs_l, lon, arrs_r, ron)
-    cfg = JoinConfig.of(how, algo, tuple(lon), tuple(ron))
+    cfg = JoinConfig.of(how, algo, tuple(lon), tuple(ron),
+                        left_prefix, right_prefix)
     jt = cfg.join_type
     joined = _joined_names(names_l, names_r, cfg)
     lidx = tuple(names_l.index(n) for n in lon)
@@ -599,37 +890,10 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
             pid_l, pid_r, n_passes, counts_l, counts_r, gb_names, aggs_req,
             final_per_pass, agg, ddof, ctx, mode_used, t_plan0)
 
-    build_l = _SideBuilder(names_l, arrs_l, pid_l, cap_l)
-    build_r = _SideBuilder(names_r, arrs_r, pid_r, cap_r)
-
-    # -- exact output sizing over key columns only (the reference's
-    #    two-pass builder Reserve, join_utils.cpp) -----------------------
+    # -- the one compiled per-pass program (per refinement level) --------
     nk = len(lon)
     kidx = tuple(range(nk))
-    m_max = 0
-    for p in range(n_passes):
-        kc_l, cnt_l = build_l.chunk(p, only=lon)
-        kc_r, cnt_r = build_r.chunk(p, only=ron)
-        m = int(join_mod.join_row_count(kc_l, cnt_l, kc_r, cnt_r,
-                                        kidx, kidx, jt, algo))
-        m_max = max(m_max, m)
-        del kc_l, kc_r
-    out_cap = pow2ceil(max(8, m_max))
-
-    # -- the one compiled per-pass program -------------------------------
-    if gb_names is None:
-        @jax.jit
-        def prog(cl, cnt_l, cr, cnt_r):
-            jcols, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
-                                             lidx, ridx, jt, out_cap, algo)
-            return jcols, jm
-
-        def fetch(out):
-            jcols, jm = out
-            n = int(jm)
-            return {name: colmod.to_numpy(c, n)
-                    for name, c in zip(joined, jcols)}, n
-    else:
+    if gb_names is not None:
         gidx = tuple(joined.index(g) for g in gb_names)
         if final_per_pass:
             aggs_dev = tuple((joined.index(n), op) for n, op in aggs_req)
@@ -641,7 +905,21 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
             out_names = list(gb_names) + [f"{pop.name.lower()}_{n}"
                                           for n, pop in partials]
 
-        if fuse_pipeline and final_per_pass:
+    def make_prog(out_cap: int):
+        if gb_names is None:
+            @jax.jit
+            def prog(cl, cnt_l, cr, cnt_r):
+                jcols, jm = join_mod.join_gather(cl, cnt_l, cr, cnt_r,
+                                                 lidx, ridx, jt, out_cap,
+                                                 algo)
+                return jcols, jm
+
+            def fetch(out):
+                jcols, jm = out
+                n = int(jm)
+                return {name: colmod.to_numpy(c, n)
+                        for name, c in zip(joined, jcols)}, n
+        elif fuse_pipeline and final_per_pass:
             @jax.jit
             def prog(cl, cnt_l, cr, cnt_r):
                 jcols, jm = join_mod.join_gather(
@@ -657,44 +935,61 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
                 return groupby_mod.hash_groupby(jcols, jm, gidx,
                                                 aggs_dev, ddof)
 
-        def fetch(out):
-            gcols, g = out
-            n = int(g)
-            return {name: colmod.to_numpy(c, n)
-                    for name, c in zip(out_names, gcols)}, n
+        if gb_names is not None:
+            def fetch(out):
+                gcols, g = out
+                n = int(g)
+                return {name: colmod.to_numpy(c, n)
+                        for name, c in zip(out_names, gcols)}, n
+        return prog, fetch
 
-    # compile + warm on the first pass so run_seconds is steady-state
-    args0 = build_l.chunk(0) + build_r.chunk(0)
-    jax.block_until_ready(prog(*args0))
-    del args0
-    t_plan = time.perf_counter() - t_plan0
-
-    # -- streaming passes, double-buffered: pass p's program is dispatched
-    #    asynchronously, then pass p+1's host compression + upload overlap
-    #    with it before the blocking fetch (CYLON_TPU_PREFETCH=0 reverts
-    #    to strictly serial single-chunk residency) ----------------------
-    prefetch = prefetch and os.environ.get("CYLON_TPU_PREFETCH", "1") != "0"
-    t_run0 = time.perf_counter()
-    frames: List[Dict[str, np.ndarray]] = []
-    total = 0
-    nxt = (build_l.chunk(0) + build_r.chunk(0)) if prefetch else None
-    for p in range(n_passes):
-        cur = nxt if prefetch else (build_l.chunk(p) + build_r.chunk(p))
-        fut = prog(*cur)                          # async dispatch
-        nxt = (build_l.chunk(p + 1) + build_r.chunk(p + 1)
-               if prefetch and p + 1 < n_passes else None)
-        frame, n = fetch(fut)
-        total += n
-        frames.append(frame)
-        del cur, fut
-        if PASS_PROGRESS_HOOK is not None:
-            PASS_PROGRESS_HOOK(p + 1, n_passes, total,
-                               time.perf_counter() - t_run0)
-    del nxt
-    result = _concat_host(frames)
-    stats = {"passes": n_passes, "mode": mode_used, "chunk_cap": max(cap_l, cap_r),
-             "cap_l": cap_l, "cap_r": cap_r, "out_cap": out_cap,
+    # -- resilient streaming: build one level's execution over the
+    #    REMAINING parts only (capacities shrink as passes split), keep
+    #    completed host frames, resume on recoverable failures ----------
+    plan = _RefinablePlan(pid_l, pid_r, n_passes, mode_used,
+                          keys_l_arr, keys_r_arr)
+    policy = ctx.retry_policy() if ctx is not None \
+        else resilience.RetryPolicy.from_env()
+    stats = {"passes": n_passes, "mode": mode_used,
+             "chunk_cap": max(cap_l, cap_r), "cap_l": cap_l, "cap_r": cap_r,
              "world": 1}
+
+    def make_exec(parts, level):
+        pid_l_lvl, pid_r_lvl = plan.pids(level)
+        max_l, max_r = plan.max_part_rows(parts, level)
+        cap_l_lvl = pow2ceil(max(8, max_l))
+        cap_r_lvl = pow2ceil(max(8, max_r))
+        build_l = _SideBuilder(names_l, arrs_l, pid_l_lvl, cap_l_lvl)
+        build_r = _SideBuilder(names_r, arrs_r, pid_r_lvl, cap_r_lvl)
+        # exact output sizing over key columns only (the reference's
+        # two-pass builder Reserve, join_utils.cpp), remaining parts only
+        m_max = 0
+        for p in parts:
+            kc_l, cnt_l = build_l.chunk(p, only=lon)
+            kc_r, cnt_r = build_r.chunk(p, only=ron)
+            m = int(join_mod.join_row_count(kc_l, cnt_l, kc_r, cnt_r,
+                                            kidx, kidx, jt, algo))
+            m_max = max(m_max, m)
+            del kc_l, kc_r
+        out_cap = pow2ceil(max(8, m_max))
+        stats.update(chunk_cap=max(cap_l_lvl, cap_r_lvl), cap_l=cap_l_lvl,
+                     cap_r=cap_r_lvl, out_cap=out_cap)
+        prog, fetch = make_prog(out_cap)
+
+        def chunk(p):
+            return build_l.chunk(p) + build_r.chunk(p)
+
+        # compile + warm on the first remaining pass so run_seconds is
+        # steady-state
+        args0 = chunk(parts[0])
+        jax.block_until_ready(prog(*args0))
+        del args0
+        return chunk, prog, fetch
+
+    t_plan, t_run0, frames, total = _stream_recoverable(
+        make_exec, plan, t_plan0, policy=policy, stats=stats,
+        prefetch=prefetch)
+    result = _concat_host(frames)
     if gb_names is not None and not final_per_pass:
         result, total = _combine_partials(result, gb_names, aggs_req,
                                           arrs_l, arrs_r, names_l, names_r,
@@ -815,7 +1110,13 @@ def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
     t_run0 = time.perf_counter()
     frames = []
     total = 0
-    for p in range(n_passes):
+    # each pass is a fresh collective program over the mesh; retrying it
+    # is only mesh-safe single-process (see collective_retry_policy)
+    policy = ctx.collective_retry_policy()
+    retries = 0
+
+    def run_pass(p: int):
+        resilience.fault_point("pass_dispatch")
         sel_l = pid_l == p
         sel_r = pid_r == p
         lt = Table.from_numpy(names_l, [np.asarray(arrs_l[n])[sel_l]
@@ -827,12 +1128,21 @@ def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
         j = lt.distributed_join(rt, left_on=lon, right_on=ron, how=how,
                                 algorithm=cfg.algorithm)
         if gb_names is None:
-            frames.append(j.to_numpy())
-            total += j.row_count
-        else:
-            g = j.groupby(gb_names, pass_agg, ddof=ddof)
-            frames.append(g.to_numpy())
-            total += g.row_count
+            return j.to_numpy(), j.row_count
+        g = j.groupby(gb_names, pass_agg, ddof=ddof)
+        return g.to_numpy(), g.row_count
+
+    for p in range(n_passes):
+        # transient (comm/deadline) failures retry the PASS, not the whole
+        # stream: completed frames are the checkpoint
+        (frame, n), attempts = resilience.retry_call(
+            lambda p=p: run_pass(p), policy=policy,
+            site=f"distributed pass {p}/{n_passes}")
+        retries += attempts - 1
+        frames.append(frame)
+        total += n
+        _notify_progress(p + 1, n_passes, total,
+                         time.perf_counter() - t_run0)
     result = _concat_host(frames)
     if gb_names is not None and not final_per_pass:
         result, total = _combine_partials(result, gb_names, aggs_req,
@@ -840,7 +1150,7 @@ def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
                                           joined, ddof, ctx)
     t_run = time.perf_counter() - t_run0
     stats = {"passes": n_passes, "mode": mode_used, "world": world,
-             "shard_cap": shard_cap,
+             "shard_cap": shard_cap, "retries": retries,
              "groups" if gb_names is not None else "rows": total,
              "plan_seconds": t_plan, "run_seconds": t_run,
              "total_seconds": t_plan + t_run}
@@ -896,26 +1206,43 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
             frames.append(g.to_numpy())
             total += g.row_count
     else:
-        build = _SideBuilder(names, arrs, pid, cap)
-
-        @jax.jit
-        def prog(cols, cnt):
-            return groupby_mod.hash_groupby(cols, cnt, by_idx, aggs_dev,
-                                            ddof)
-
         def fetch(out):
             gcols, g = out
             n = int(g)
             return {name: colmod.to_numpy(c, n)
                     for name, c in zip(out_names, gcols)}, n
 
-        t_plan, t_run0, frames, total = _run_passes(
-            prog, build.empty_chunk, build.chunk, n_passes, fetch, t0)
+        # the partition keys ARE the group keys, so hash-refining a part
+        # never splits a group across passes: full OOM recovery applies
+        plan = _RefinablePlan(pid, np.zeros(0, np.int32), n_passes,
+                              mode_used, key_arrs, [])
+        extra: Dict = {}
+
+        def make_exec(parts, level):
+            pid_lvl, _ = plan.pids(level)
+            max_rows, _ = plan.max_part_rows(parts, level)
+            cap_lvl = pow2ceil(max(8, max_rows))
+            build = _SideBuilder(names, arrs, pid_lvl, cap_lvl)
+
+            @jax.jit
+            def prog(cols, cnt):
+                return groupby_mod.hash_groupby(cols, cnt, by_idx, aggs_dev,
+                                                ddof)
+
+            warm = build.empty_chunk()
+            jax.block_until_ready(prog(*warm))
+            del warm
+            return build.chunk, prog, fetch
+
+        t_plan, t_run0, frames, total = _stream_recoverable(
+            make_exec, plan, t0, stats=extra)
     result = _concat_host(frames)
     t_run = time.perf_counter() - t_run0
     stats = {"passes": n_passes, "mode": mode_used, "world": world,
              "groups": total, "plan_seconds": t_plan,
              "run_seconds": t_run, "total_seconds": t_plan + t_run}
+    if world == 1:
+        stats.update(extra)
     return result, stats
 
 

@@ -353,8 +353,10 @@ def exchange_bytes(ctx, per_target: Sequence[np.ndarray]) -> List[np.ndarray]:
         return (collectives.all_to_all(chunk[0]),
                 collectives.all_to_all(lens[0][:, None])[:, 0])
 
+    from ..utils import shard_map
+
     spec = P(PARTITION_AXIS)
-    out, out_lens = jax.jit(jax.shard_map(
+    out, out_lens = jax.jit(shard_map(
         fn, mesh=ctx.mesh, in_specs=spec, out_specs=spec,
         check_vma=False))(sendbuf, lengths)
     out = np.asarray(out).reshape(world, world, maxlen)

@@ -34,13 +34,14 @@ def _shard_map(ctx: CylonContext, fn, key: tuple, shapes_key: tuple,
     from jax.sharding import PartitionSpec as P
 
     from ..context import ctx_cache
+    from ..utils import shard_map
 
     cache = ctx_cache(ctx, "_plan_cache")
     cache_key = (key, shapes_key)
     entry = cache.get(cache_key)
     if entry is None:
         spec = P(PARTITION_AXIS)
-        entry = jax.jit(jax.shard_map(
+        entry = jax.jit(shard_map(
             fn, mesh=ctx.mesh, in_specs=spec,
             out_specs=spec if out_specs is None else out_specs,
             check_vma=False))
@@ -127,9 +128,11 @@ def _probe_ragged(ctx) -> bool:
         return jax.lax.ragged_all_to_all(x, out, io, ones, oo, ones,
                                          axis_name=PARTITION_AXIS)
 
+    from ..utils import shard_map
+
     try:
-        f = jax.jit(jax.shard_map(fn, mesh=ctx.mesh, in_specs=P(PARTITION_AXIS),
-                                  out_specs=P(PARTITION_AXIS), check_vma=False))
+        f = jax.jit(shard_map(fn, mesh=ctx.mesh, in_specs=P(PARTITION_AXIS),
+                              out_specs=P(PARTITION_AXIS), check_vma=False))
         jax.block_until_ready(f(jnp.zeros((world * world,), jnp.int32)))
         return True
     except Exception as e:
@@ -170,6 +173,7 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
     no bucket padding, targets computed once); if the active backend lacks
     the ragged collective the bucketed path is used and remembered.
     """
+    from .. import resilience
     from ..table import Table
     from ..utils import span
 
@@ -177,38 +181,54 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
     ctx = t.ctx
     names = t.names
 
-    # phase timers mirror the reference's split/shuffle chrono spans
-    # (partition/partition.cpp:29-57, table.cpp:163-175)
-    if _ragged_enabled(ctx):
+    def exchange():
+        # the named injection site for the collective exchange; a real or
+        # injected transient failure retries the WHOLE plan+exchange (the
+        # input table is untouched, so the retry is exact)
+        resilience.fault_point("shuffle")
+        # phase timers mirror the reference's split/shuffle chrono spans
+        # (partition/partition.cpp:29-57, table.cpp:163-175)
+        if _ragged_enabled(ctx):
+            with span("shuffle.plan"):
+                # sized here, inside the retried exchange — the task-graph
+                # path also calls plan_shuffle, so the injection site
+                # lives with the recovery wrapper, not the sizing math
+                resilience.fault_point("shuffle_plan")
+                targets, counts = _targets_and_counts(t, key_idx, mode, opts)
+                _, out_cap = shuffle_mod.plan_shuffle(
+                    np.asarray(counts).reshape(world, world))
+
+            def rfn(tt, tgt):
+                cols, total = shuffle_mod.shuffle_shard_ragged(
+                    tt.columns, tgt, world, out_cap)
+                return Table(cols, jnp.reshape(total, (1,)), names, ctx)
+
+            with span("shuffle.exchange"):
+                return _shard_map(ctx, rfn,
+                                  ("shuffle-ragged", key_idx, out_cap),
+                                  _shapes_key(t))(t, targets)
+
         with span("shuffle.plan"):
-            targets, counts = _targets_and_counts(t, key_idx, mode, opts)
-            _, out_cap = shuffle_mod.plan_shuffle(
+            resilience.fault_point("shuffle_plan")
+            counts = _counts_for(t, key_idx, mode, opts)
+            bucket, out_cap = shuffle_mod.plan_shuffle(
                 np.asarray(counts).reshape(world, world))
 
-        def rfn(tt, tgt):
-            cols, total = shuffle_mod.shuffle_shard_ragged(
-                tt.columns, tgt, world, out_cap)
+        def fn(tt):
+            tgt = _targets(tt, key_idx, world, mode, opts)
+            cols, total = shuffle_mod.shuffle_shard(
+                tt.columns, tt.row_counts[0], tgt, world, bucket, out_cap)
             return Table(cols, jnp.reshape(total, (1,)), names, ctx)
 
         with span("shuffle.exchange"):
-            return _shard_map(ctx, rfn, ("shuffle-ragged", key_idx, out_cap),
-                              _shapes_key(t))(t, targets)
+            return _shard_map(ctx, fn,
+                              ("shuffle", key_idx, mode, opts, bucket,
+                               out_cap),
+                              _shapes_key(t))(t)
 
-    with span("shuffle.plan"):
-        counts = _counts_for(t, key_idx, mode, opts)
-        bucket, out_cap = shuffle_mod.plan_shuffle(
-            np.asarray(counts).reshape(world, world))
-
-    def fn(tt):
-        tgt = _targets(tt, key_idx, world, mode, opts)
-        cols, total = shuffle_mod.shuffle_shard(tt.columns, tt.row_counts[0],
-                                                tgt, world, bucket, out_cap)
-        return Table(cols, jnp.reshape(total, (1,)), names, ctx)
-
-    with span("shuffle.exchange"):
-        return _shard_map(ctx, fn,
-                          ("shuffle", key_idx, mode, opts, bucket, out_cap),
-                          _shapes_key(t))(t)
+    out, _attempts = resilience.retry_call(
+        exchange, policy=ctx.collective_retry_policy(), site="shuffle")
+    return out
 
 
 def shuffle(t, key_idx: Tuple[int, ...]):

@@ -1,0 +1,297 @@
+"""Resilience layer: error classification, bounded retry, and a
+deterministic fault-injection harness for the out-of-core engine.
+
+The reference survives scale by adding MPI ranks; the TPU analog streams
+key-domain passes through one static XLA program (exec.py) — which makes
+HBM pressure a *recoverable* condition: when a one-shot program exceeds
+memory, decompose it into more, smaller passes and retry only the parts
+that have not completed (the shape of "Memory-efficient array
+redistribution through portable collective communication", PAPERS.md).
+This module supplies the three primitives the engine, the table-level
+one-shot ops, and the bench harness share:
+
+- **classification** — `Status.from_exception` (status.py) maps
+  ``XlaRuntimeError``/PJRT failure text into the `Code` taxonomy
+  (``RESOURCE_EXHAUSTED`` → `Code.OutOfMemory`, transient comm/deadline
+  failures → `Code.ExecutionError`); `RETRYABLE_CODES` names which of
+  those a plain retry may heal (OOM is NOT among them — it is healed by
+  pass-splitting, not by doing the same allocation again);
+- **RetryPolicy / retry_call** — bounded exponential backoff driven by
+  ``CYLON_TPU_RETRY_MAX`` / ``CYLON_TPU_RETRY_BASE_S`` /
+  ``CYLON_TPU_RETRY_MAX_S``;
+- **fault injection** — named `fault_point(site)` probes (pass_dispatch,
+  host_fetch, shuffle, probe_spawn, oneshot_join, oneshot_groupby, ...)
+  driven by a ``CYLON_TPU_FAULT_PLAN`` spec, so every recovery path is
+  exercised deterministically on CPU in tier-1 tests — no real TPU OOM
+  needed.  Injected faults carry the same message shapes PJRT emits, so
+  they flow through the exact classification path real failures take.
+
+Fault-plan spec grammar (';'- or ','-separated entries)::
+
+    site            fire an OOM on the 1st hit of `site`
+    site@N          fire an OOM on the Nth hit (1-based)
+    site@N=kind     kind in {oom, timeout, comm, unknown}
+    site@N+=kind    fire on EVERY hit >= N (persistent fault)
+
+e.g. ``CYLON_TPU_FAULT_PLAN="pass_dispatch@2=oom;probe_spawn@1=timeout"``.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .status import Code, CylonError, Status
+
+# Codes a plain bounded retry may heal.  OutOfMemory is deliberately
+# absent: repeating an identical allocation cannot succeed — the engine
+# heals OOM by splitting the remaining key-domain parts instead.
+RETRYABLE_CODES = frozenset({Code.ExecutionError})
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def max_oom_splits() -> int:
+    """How many times the engine may double the pass count before a device
+    OOM becomes fatal (``CYLON_TPU_MAX_OOM_SPLITS``, default 4 — a 16x
+    refinement of the original plan)."""
+    return max(0, _env_int("CYLON_TPU_MAX_OOM_SPLITS", 4))
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RetryPolicy:
+    """Bounded exponential backoff for transient (`Code.ExecutionError`)
+    failures.  ``max_retries`` is the number of RE-tries: an operation is
+    attempted at most ``max_retries + 1`` times."""
+
+    max_retries: int = 2
+    base_s: float = 0.05
+    max_s: float = 2.0
+    multiplier: float = 2.0
+    sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(
+            max_retries=max(0, _env_int("CYLON_TPU_RETRY_MAX", 2)),
+            base_s=max(0.0, _env_float("CYLON_TPU_RETRY_BASE_S", 0.05)),
+            max_s=max(0.0, _env_float("CYLON_TPU_RETRY_MAX_S", 2.0)))
+
+    def delay(self, retry_index: int) -> float:
+        """Backoff before the ``retry_index``-th retry (0-based)."""
+        return min(self.base_s * (self.multiplier ** retry_index), self.max_s)
+
+    def delays(self):
+        for i in range(self.max_retries):
+            yield self.delay(i)
+
+
+def retry_call(fn, *, policy: Optional[RetryPolicy] = None, site: str = "op",
+               retryable: frozenset = RETRYABLE_CODES,
+               on_retry: Optional[Callable] = None) -> Tuple[object, int]:
+    """Run ``fn()`` under ``policy``'s bounded backoff.
+
+    Returns ``(result, attempts)``.  Exceptions whose classified code is
+    not in ``retryable`` propagate unchanged (a TypeError must stay a
+    TypeError); exhausting the retries raises `CylonError` with the
+    classified code and the last failure's message.
+    """
+    policy = policy or RetryPolicy.from_env()
+    attempts = 0
+    while True:
+        attempts += 1
+        try:
+            return fn(), attempts
+        except Exception as e:
+            st = Status.from_exception(e)
+            if st.code not in retryable:
+                raise
+            retry_index = attempts - 1
+            if retry_index >= policy.max_retries:
+                raise CylonError(
+                    st.code,
+                    f"{site}: retries exhausted after {attempts} attempts: "
+                    f"{st.msg}") from e
+            if on_retry is not None:
+                on_retry(attempts, st)
+            d = policy.delay(retry_index)
+            if d > 0:
+                policy.sleep(d)
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault injection
+# ---------------------------------------------------------------------------
+
+# Message shapes mirror real PJRT/collective failure text so injected
+# faults exercise the SAME classification path genuine failures take.
+_KIND_MESSAGES = {
+    "oom": ("RESOURCE_EXHAUSTED: injected fault at {site} (hit {hit}): "
+            "attempting to allocate past HBM capacity"),
+    "timeout": ("DEADLINE_EXCEEDED: injected fault at {site} (hit {hit}): "
+                "operation timed out"),
+    "comm": ("UNAVAILABLE: injected fault at {site} (hit {hit}): "
+             "connection reset by peer"),
+    "unknown": "INTERNAL: injected fault at {site} (hit {hit})",
+}
+
+FAULT_KINDS = tuple(_KIND_MESSAGES)
+
+
+class InjectedFault(RuntimeError):
+    """Synthetic failure raised at a named `fault_point`."""
+
+    def __init__(self, site: str, kind: str, hit: int):
+        self.site = site
+        self.kind = kind
+        self.hit = hit
+        super().__init__(_KIND_MESSAGES[kind].format(site=site, hit=hit))
+
+
+@dataclass
+class _FaultRule:
+    site: str
+    nth: int          # 1-based hit index on which to fire
+    kind: str
+    persistent: bool  # fire on every hit >= nth
+
+
+class FaultPlan:
+    """Parsed ``CYLON_TPU_FAULT_PLAN``: per-site hit counters + rules.
+
+    Deterministic by construction: a site's Nth hit either always fires
+    or never does, independent of timing.  ``hits`` and ``fired`` are
+    exposed so tests can assert a site was actually exercised.
+    """
+
+    def __init__(self, rules: List[_FaultRule], spec: str = ""):
+        self.rules = rules
+        self.spec = spec
+        self.hits: Dict[str, int] = {}
+        self.fired: List[Tuple[str, str, int]] = []  # (site, kind, hit)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        rules: List[_FaultRule] = []
+        for raw in spec.replace(",", ";").split(";"):
+            entry = raw.strip()
+            if not entry:
+                continue
+            persistent = False
+            kind = "oom"
+            if "=" in entry:
+                entry, kind = entry.split("=", 1)
+                kind = kind.strip().lower()
+                if entry.endswith("+"):
+                    persistent = True
+                    entry = entry[:-1]
+            if kind not in _KIND_MESSAGES:
+                raise CylonError(Code.Invalid,
+                                 f"bad fault kind {kind!r} in "
+                                 f"CYLON_TPU_FAULT_PLAN entry {raw!r} "
+                                 f"(expected one of {FAULT_KINDS})")
+            nth = 1
+            if "@" in entry:
+                entry, n = entry.split("@", 1)
+                try:
+                    nth = int(n)
+                except ValueError:
+                    raise CylonError(Code.Invalid,
+                                     f"bad hit index {n!r} in "
+                                     f"CYLON_TPU_FAULT_PLAN entry {raw!r}")
+                if nth < 1:
+                    raise CylonError(Code.Invalid,
+                                     f"hit index must be >= 1 in {raw!r}")
+            site = entry.strip()
+            if not site:
+                raise CylonError(Code.Invalid,
+                                 f"empty site in CYLON_TPU_FAULT_PLAN "
+                                 f"entry {raw!r}")
+            rules.append(_FaultRule(site, nth, kind, persistent))
+        return cls(rules, spec)
+
+    def check(self, site: str) -> Optional[str]:
+        """Record one hit of ``site``; return the fault kind to raise, or
+        None."""
+        hit = self.hits.get(site, 0) + 1
+        self.hits[site] = hit
+        for r in self.rules:
+            if r.site != site:
+                continue
+            if hit == r.nth or (r.persistent and hit >= r.nth):
+                self.fired.append((site, r.kind, hit))
+                return r.kind
+        return None
+
+
+# Override plan (tests, via the fault_plan() context manager) wins over the
+# env-driven plan; the env plan object persists while the spec string is
+# unchanged so its hit counters accumulate across sites in one process.
+_OVERRIDE_PLAN: Optional[FaultPlan] = None
+_ENV_PLAN: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    global _ENV_PLAN
+    if _OVERRIDE_PLAN is not None:
+        return _OVERRIDE_PLAN
+    spec = os.environ.get("CYLON_TPU_FAULT_PLAN", "")
+    if not spec:
+        _ENV_PLAN = None
+        return None
+    if _ENV_PLAN is None or _ENV_PLAN.spec != spec:
+        _ENV_PLAN = FaultPlan.parse(spec)
+    return _ENV_PLAN
+
+
+def fault_point(site: str) -> None:
+    """Injection probe: no-op unless an active fault plan names ``site``
+    and its hit counter matches.  Costs one dict lookup when no plan is
+    active — safe on hot paths."""
+    plan = _OVERRIDE_PLAN
+    if plan is None:
+        if not os.environ.get("CYLON_TPU_FAULT_PLAN"):
+            return
+        plan = active_plan()
+        if plan is None:
+            return
+    kind = plan.check(site)
+    if kind is not None:
+        raise InjectedFault(site, kind, plan.hits[site])
+
+
+@contextlib.contextmanager
+def fault_plan(spec: str):
+    """Install a fresh fault plan for the duration of the block (tests).
+    Yields the `FaultPlan` so callers can assert on ``hits``/``fired``."""
+    global _OVERRIDE_PLAN
+    prev = _OVERRIDE_PLAN
+    plan = FaultPlan.parse(spec)
+    _OVERRIDE_PLAN = plan
+    try:
+        yield plan
+    finally:
+        _OVERRIDE_PLAN = prev
+
+
+def classify(exc: BaseException) -> Code:
+    """Shorthand: the classified `Code` of an exception."""
+    return Status.from_exception(exc).code

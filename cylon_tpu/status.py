@@ -34,6 +34,25 @@ class Code(enum.IntEnum):
     AlreadyExists = 45
 
 
+# Failure-text classification tables (lowercase substrings).  PJRT raises
+# one exception type (XlaRuntimeError) whose message carries the absl
+# status code, so classification is textual by necessity; the patterns
+# cover the RESOURCE_EXHAUSTED / allocator shapes TPU OOMs actually emit
+# and the deadline/comm shapes a flaky tunnel emits.  resilience.py's
+# injected faults reuse these exact message shapes.
+_OOM_PATTERNS = (
+    "resource_exhausted", "resource exhausted", "out of memory",
+    "failed to allocate", "allocation failure", "exceeds hbm",
+    "hbm capacity", "exceeds the memory",
+)
+_TRANSIENT_PATTERNS = (
+    "deadline_exceeded", "deadline exceeded", "timed out", "timeout",
+    "unavailable", "connection reset", "connection refused",
+    "connection closed", "socket closed", "broken pipe", "aborted",
+    "cancelled", "preempt", "network error",
+)
+
+
 @dataclass(frozen=True)
 class Status:
     """Operation status (reference: cpp/src/cylon/status.hpp).
@@ -47,6 +66,34 @@ class Status:
     @staticmethod
     def OK() -> "Status":
         return Status(Code.OK, "")
+
+    @staticmethod
+    def from_exception(exc: BaseException) -> "Status":
+        """Classify an exception into the `Code` taxonomy.
+
+        `CylonError` keeps its own code; `MemoryError` and PJRT
+        ``RESOURCE_EXHAUSTED``/allocator text map to `Code.OutOfMemory`;
+        deadline/comm failure text maps to retryable `Code.ExecutionError`;
+        anything unrecognized is `Code.UnknownError` (never retried, never
+        split — a TypeError must surface as the bug it is)."""
+        if isinstance(exc, CylonError):
+            return Status(exc.code, exc.msg)
+        msg = f"{type(exc).__name__}: {exc}"
+        if isinstance(exc, MemoryError):
+            return Status(Code.OutOfMemory, msg)
+        if isinstance(exc, (TimeoutError, ConnectionError)):
+            return Status(Code.ExecutionError, msg)
+        # message-text matching is for PJRT/XLA failures, which surface as
+        # RuntimeError (XlaRuntimeError's base); on any other type the
+        # text is a bug's wording — e.g. ValueError("... timed out") —
+        # and must stay unknown, never retried or split
+        if isinstance(exc, RuntimeError):
+            low = str(exc).lower()
+            if any(p in low for p in _OOM_PATTERNS):
+                return Status(Code.OutOfMemory, msg)
+            if any(p in low for p in _TRANSIENT_PATTERNS):
+                return Status(Code.ExecutionError, msg)
+        return Status(Code.UnknownError, msg)
 
     def is_ok(self) -> bool:
         return self.code == Code.OK

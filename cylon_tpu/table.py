@@ -535,9 +535,35 @@ class Table:
              algorithm="sort") -> "Table":
         """Shard-local join (reference: join::joinTables via Table::Join,
         table.cpp:441-457). For distributed tables this joins shard-by-shard;
-        use :meth:`distributed_join` for the shuffled global join."""
+        use :meth:`distributed_join` for the shuffled global join.
+
+        If the one-shot device program exceeds HBM (the join OUTPUT can
+        dwarf resident inputs), single-shard tables fall back to the
+        chunked out-of-core engine instead of dying
+        (``CYLON_TPU_ONESHOT_FALLBACK=0`` disables)."""
+        from . import resilience
+
         cfg = _join_config(self, other, config, on, left_on, right_on, how, algorithm)
-        return _local_join(self, other, cfg)
+        try:
+            resilience.fault_point("oneshot_join")
+            return _local_join(self, other, cfg)
+        except Exception as e:
+            if not _oneshot_oom_fallback(self, other, e):
+                raise
+            how_s = {JoinType.INNER: "inner", JoinType.LEFT: "left",
+                     JoinType.RIGHT: "right",
+                     JoinType.FULL_OUTER: "outer"}[cfg.join_type]
+            algo_s = ("hash" if cfg.algorithm == JoinAlgorithm.HASH
+                      else "sort")
+            from . import exec as exec_mod
+
+            res, _stats = exec_mod.chunked_join(
+                self, other, left_on=list(cfg.left_on),
+                right_on=list(cfg.right_on), how=how_s, algo=algo_s,
+                passes=_fallback_passes(), left_prefix=cfg.left_prefix,
+                right_prefix=cfg.right_prefix)
+            expected = _join_output_names(self, other, cfg)
+            return _table_from_fallback(res, expected, self.ctx)
 
     def distributed_join(self, other: "Table", config: Optional[JoinConfig] = None,
                          *, on=None, left_on=None, right_on=None, how="inner",
@@ -645,7 +671,28 @@ class Table:
                 aggs.append((ci, AggOp.of(op)))
         pipeline = groupby_type == "pipeline"
         if self.num_shards == 1:
-            return _local_groupby(self, by_idx, tuple(aggs), ddof, pipeline)
+            from . import resilience
+
+            try:
+                resilience.fault_point("oneshot_groupby")
+                return _local_groupby(self, by_idx, tuple(aggs), ddof,
+                                      pipeline)
+            except Exception as e:
+                # the chunked engine is hash-based: substituting it for a
+                # pipeline (run-length) group-by would silently merge
+                # non-adjacent key runs, so pipeline never falls back
+                if pipeline or not _oneshot_oom_fallback(self, None, e):
+                    raise
+                from . import exec as exec_mod
+
+                agg_by_name: Dict[str, list] = {}
+                for ci, op in aggs:
+                    agg_by_name.setdefault(self.names[ci], []).append(op)
+                res, _stats = exec_mod.chunked_groupby(
+                    self, [self.names[i] for i in by_idx], agg_by_name,
+                    ddof=ddof, passes=_fallback_passes())
+                expected = _groupby_output_names(self, by_idx, tuple(aggs))
+                return _table_from_fallback(res, expected, self.ctx)
         from .parallel import ops as par_ops
 
         return par_ops.distributed_groupby(self, by_idx, tuple(aggs), ddof,
@@ -964,9 +1011,11 @@ def _shard_wise(ctx: CylonContext, fn, *tables: Table, key: tuple):
                        for t in tables))
     entry = cache.get(cache_key)
     if entry is None:
+        from .utils import shard_map
+
         spec = P(PARTITION_AXIS)
-        entry = jax.jit(jax.shard_map(fn, mesh=ctx.mesh, in_specs=spec,
-                                      out_specs=spec, check_vma=False))
+        entry = jax.jit(shard_map(fn, mesh=ctx.mesh, in_specs=spec,
+                                  out_specs=spec, check_vma=False))
         cache[cache_key] = entry
     return entry(*tables)
 
@@ -1131,6 +1180,51 @@ def _cap_round(n: int) -> int:
         return 16
     g = 1 << ((n - 1).bit_length() - 3)
     return -(-n // g) * g
+
+
+def _oneshot_oom_fallback(left: Table, right: Optional[Table],
+                          exc: Exception) -> bool:
+    """True when a failed one-shot device op should fall back to the
+    chunked out-of-core engine: the failure classifies as OutOfMemory
+    (real RESOURCE_EXHAUSTED or injected), every involved table is
+    single-shard (distributed recovery is the mesh's job), and the knob
+    (``CYLON_TPU_ONESHOT_FALLBACK``, default on) allows it."""
+    import os
+
+    from .status import Status
+
+    if Status.from_exception(exc).code != Code.OutOfMemory:
+        return False
+    if os.environ.get("CYLON_TPU_ONESHOT_FALLBACK", "1") == "0":
+        return False
+    if left.num_shards != 1 or (right is not None and right.num_shards != 1):
+        return False
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "one-shot device program exceeded memory (%s); falling back to the "
+        "chunked out-of-core engine", type(exc).__name__)
+    return True
+
+
+def _fallback_passes() -> int:
+    """Initial pass count for the one-shot -> chunked fallback
+    (``CYLON_TPU_FALLBACK_PASSES``, default 4); the chunked engine's own
+    OOM recovery refines further if even that is too coarse."""
+    import os
+
+    try:
+        return max(2, int(os.environ.get("CYLON_TPU_FALLBACK_PASSES", "4")))
+    except ValueError:
+        return 4
+
+
+def _table_from_fallback(res: Dict[str, np.ndarray], expected, ctx) -> Table:
+    """Host-column dict from the chunked engine -> Table, reordered to the
+    one-shot op's output schema when the names agree."""
+    if set(res) == set(expected):
+        res = {n: res[n] for n in expected}
+    return Table.from_numpy(list(res), list(res.values()), ctx=ctx)
 
 
 def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
