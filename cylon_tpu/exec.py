@@ -1149,8 +1149,14 @@ def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
                                           arrs_l, arrs_r, names_l, names_r,
                                           joined, ddof, ctx)
     t_run = time.perf_counter() - t_run0
+    from .parallel import plane as plane_mod
+
+    # every mesh pass shuffles through parallel.ops; record which exchange
+    # realization (packed plane vs per-buffer) the artifact was measured
+    # under — the battery's A/B arms depend on this being in the ledger
     stats = {"passes": n_passes, "mode": mode_used, "world": world,
              "shard_cap": shard_cap, "retries": retries,
+             "shuffle_pack": plane_mod.pack_enabled(),
              "groups" if gb_names is not None else "rows": total,
              "plan_seconds": t_plan, "run_seconds": t_run,
              "total_seconds": t_plan + t_run}
@@ -1358,8 +1364,11 @@ def chunked_repartition(data, keys, world: int, *, passes: int = 4,
         result = (None if out_dir is not None
                   else [_concat_host(fs) for fs in acc])
         t_run = time.perf_counter() - t_run0
+        from .parallel import plane as plane_mod
+
         stats = {"passes": n_passes, "world": wctx, "rows": total,
                  "per_target": per_target.tolist(),
+                 "shuffle_pack": plane_mod.pack_enabled(),
                  "plan_seconds": t_plan, "run_seconds": t_run,
                  "total_seconds": t_plan + t_run}
         return result, stats

@@ -35,5 +35,16 @@ def all_to_all(x, split_axis: int = 0, concat_axis: int = 0):
                               concat_axis=concat_axis, tiled=True)
 
 
+def ragged_all_to_all(operand, output, input_offsets, send_sizes,
+                      output_offsets, recv_sizes):
+    """``lax.ragged_all_to_all`` over the partition axis (exact-traffic
+    exchange; not implemented by every backend — callers probe via
+    parallel.ops._ragged_enabled).  Centralized so the packed-plane and
+    per-buffer shuffle bodies share one launch site."""
+    return jax.lax.ragged_all_to_all(
+        operand, output, input_offsets, send_sizes, output_offsets,
+        recv_sizes, axis_name=PARTITION_AXIS)
+
+
 def my_rank():
     return jax.lax.axis_index(PARTITION_AXIS)

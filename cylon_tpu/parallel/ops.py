@@ -25,6 +25,7 @@ from ..ops import sort as sort_mod
 from ..ops.groupby import AggOp
 from . import collectives
 from . import partition as partition_mod
+from . import plane as plane_mod
 from . import shuffle as shuffle_mod
 
 
@@ -188,6 +189,10 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
         resilience.fault_point("shuffle")
         # phase timers mirror the reference's split/shuffle chrono spans
         # (partition/partition.cpp:29-57, table.cpp:163-175)
+        # the packed-plane knob is read at trace time, so it must key the
+        # plan cache — flipping CYLON_TPU_SHUFFLE_PACK can never serve a
+        # program traced under the other realization
+        pack = plane_mod.pack_enabled()
         if _ragged_enabled(ctx):
             with span("shuffle.plan"):
                 # sized here, inside the retried exchange — the task-graph
@@ -205,7 +210,7 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
 
             with span("shuffle.exchange"):
                 return _shard_map(ctx, rfn,
-                                  ("shuffle-ragged", key_idx, out_cap),
+                                  ("shuffle-ragged", key_idx, out_cap, pack),
                                   _shapes_key(t))(t, targets)
 
         with span("shuffle.plan"):
@@ -223,7 +228,7 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
         with span("shuffle.exchange"):
             return _shard_map(ctx, fn,
                               ("shuffle", key_idx, mode, opts, bucket,
-                               out_cap),
+                               out_cap, pack),
                               _shapes_key(t))(t)
 
     out, _attempts = resilience.retry_call(
@@ -274,13 +279,26 @@ def hash_partition(t, key_idx: Tuple[int, ...], num_partitions: int):
     cm = np.asarray(counts).reshape(nshards, num_partitions)
     caps = tuple(min(pow2ceil(c), t.shard_capacity) for c in cm.max(axis=0))
 
+    # under the packed-exchange knob the per-partition compaction gathers
+    # run once on the bit-packed plane (num_partitions gathers total)
+    # instead of once per column per partition — same machinery as the
+    # shuffle exchange, minus the collective (this op is purely local)
+    pack = plane_mod.pack_enabled()
+
     def pfn(tt, tgt):
+        packed = plane_mod.pack_plane(tt.columns) if pack else None
         outs = []
         for p in range(num_partitions):
             perm, m = compact_mod.compact_indices(tgt == p)
             idx = perm[: caps[p]]
             valid = jnp.arange(caps[p], dtype=jnp.int32) < m
-            cols = tuple(c.take(idx, valid_mask=valid) for c in tt.columns)
+            if pack:
+                cols = plane_mod.unpack_plane(
+                    jnp.take(packed, idx, axis=0, mode="clip"),
+                    tt.columns, valid_mask=valid)
+            else:
+                cols = tuple(c.take(idx, valid_mask=valid)
+                             for c in tt.columns)
             outs.append(Table(cols, jnp.reshape(m, (1,)), names, ctx))
         return tuple(outs)
 
@@ -288,7 +306,8 @@ def hash_partition(t, key_idx: Tuple[int, ...], num_partitions: int):
         parts = pfn(t, targets)
     else:
         parts = _shard_map(ctx, pfn,
-                           ("hash_partition", key_idx, num_partitions, caps),
+                           ("hash_partition", key_idx, num_partitions, caps,
+                            pack),
                            _shapes_key(t))(t, targets)
     return {p: parts[p] for p in range(num_partitions)}
 

@@ -1,0 +1,228 @@
+"""Column-packed single-collective exchange plane.
+
+The shuffle is the framework's central primitive (reference:
+cpp/src/cylon/arrow/arrow_all_to_all.cpp:24-236), yet the per-buffer
+exchange launches one collective PER BUFFER PER COLUMN — data, validity,
+and lengths each pay their own ``all_to_all`` / ``ragged_all_to_all``, so
+a 10-column table fires ~30 collectives per exchange.  On XLA the launch
+count and payload layout, not FLOPs, dominate collective cost ("Memory-
+efficient array redistribution through portable collective communication",
+arxiv 2112.01075; EQuARX, arxiv 2506.17615): few large transfers saturate
+ICI/DCN where many small ones serialize on launch overhead.
+
+This module bit-packs every column's data/validity/lengths buffers into
+ONE contiguous ``uint32[rows, words]`` plane per shard — the same
+packed-word discipline ``ops/keys.py::pack_operands`` proved for sort
+operands, except the plane is a round-trip format (bit-exact decode), not
+an order-preserving encoding — so the whole table moves in a single
+collective and is unpacked on the receiver.  Field layout is a pure
+function of static column metadata (dtypes, string widths), so sender and
+receiver agree by construction inside one SPMD program:
+
+- validity        -> 1 bit
+- bool data       -> 1 bit
+- 8/16-bit data   -> 8/16 bits (bitcast to unsigned)
+- 32-bit data     -> one u32 word (bitcast)
+- 64-bit data     -> two u32 words (bitcast)
+- string data     -> ceil(width/4) u32 words (4 bytes big-endian each)
+- string lengths  -> one u32 word
+
+Words are assigned first-fit-decreasing, so every 32-bit field owns one
+word and the sub-word fields (validity bits, bool/8/16-bit data) pack
+densely into the remainder — a narrow 10-column i32 table is 11 words
+(44 B/row) in ONE collective vs 50 B/row across 20 collectives unpacked.
+
+Gated by ``CYLON_TPU_SHUFFLE_PACK`` (auto = on for TPU-family backends,
+the ``ops/compact.py::permute_mode`` precedent); hardware A/B arms live
+in tools/microbench.py, tools/profile_pipeline.py and tools/tpu_battery.sh.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+
+_UINT_OF = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def pack_enabled() -> bool:
+    """Whether shuffle exchanges move one packed u32 plane instead of one
+    collective per buffer per column.  CYLON_TPU_SHUFFLE_PACK=1/0
+    overrides; "auto" (default) packs on TPU-family backends, where
+    collective launch count dominates, and stays per-buffer elsewhere.
+    Read at trace time — callers key their jit caches on it."""
+    mode = os.environ.get("CYLON_TPU_SHUFFLE_PACK", "auto")
+    if mode in ("1", "on", "packed"):
+        return True
+    if mode in ("0", "off", "perbuf"):
+        return False
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _string_word_count(col: Column) -> int:
+    return (col.string_width + 3) // 4
+
+
+def _field_widths(cols: Sequence[Column]) -> List[int]:
+    """Bit width of every plane field, in canonical column order.  Must
+    stay the exact mirror of _field_values/_rebuild_columns — the three
+    walk one shared field sequence."""
+    ws: List[int] = []
+    for c in cols:
+        ws.append(1)                                  # validity
+        if c.is_string:
+            ws.extend([32] * _string_word_count(c))   # data words
+            ws.append(32)                             # lengths
+        elif c.data.dtype == jnp.bool_:
+            ws.append(1)
+        elif c.data.dtype.itemsize == 8:
+            ws.extend([32, 32])
+        else:
+            ws.append(c.data.dtype.itemsize * 8)
+    return ws
+
+
+def _layout(widths: Sequence[int]) -> Tuple[List[Tuple[int, int, int]], int]:
+    """First-fit-decreasing assignment of fields to u32 words.  Returns
+    (slots, num_words): slots[i] = (word, shift, bits) for field i, MSB-
+    aligned within each word.  Pure static math — both ends of the
+    exchange derive the identical layout from column metadata."""
+    order = sorted(range(len(widths)), key=lambda i: (-widths[i], i))
+    slots: List[Optional[Tuple[int, int, int]]] = [None] * len(widths)
+    word, used = -1, 32
+    for i in order:
+        w = widths[i]
+        if used + w > 32:
+            word += 1
+            used = 0
+        slots[i] = (word, 32 - used - w, w)
+        used += w
+    return slots, word + 1  # type: ignore[return-value]
+
+
+def plane_words(cols: Sequence[Column]) -> int:
+    """Static u32 word count of the packed plane for this schema."""
+    return _layout(_field_widths(cols))[1]
+
+
+def _pack_string_data(data: jax.Array) -> List[jax.Array]:
+    """uint8[n, width] byte matrix -> ceil(width/4) u32[n] big-endian
+    words (the 4-byte analog of keys.pack_string_words' 8-byte packing)."""
+    n, width = data.shape
+    pad = (-width) % 4
+    if pad:
+        data = jnp.concatenate([data, jnp.zeros((n, pad), jnp.uint8)], axis=1)
+    nwords = data.shape[1] // 4
+    if nwords == 0:
+        return []
+    w = data.reshape(n, nwords, 4).astype(jnp.uint32)
+    shifts = jnp.array([24, 16, 8, 0], jnp.uint32)
+    packed = jnp.sum(w << shifts, axis=2, dtype=jnp.uint32)
+    return [packed[:, i] for i in range(nwords)]
+
+
+def _unpack_string_data(words: Sequence[jax.Array], width: int) -> jax.Array:
+    """Inverse of _pack_string_data: u32 words -> uint8[n, width].
+    ``words`` must be non-empty (zero-width matrices never pack words;
+    unpack_plane rebuilds their empty shape directly)."""
+    n = words[0].shape[0]
+    stacked = jnp.stack(words, axis=1)                    # [n, nwords]
+    shifts = jnp.array([24, 16, 8, 0], jnp.uint32)
+    bytes_ = ((stacked[:, :, None] >> shifts) & jnp.uint32(0xFF)).astype(
+        jnp.uint8).reshape(n, -1)
+    return bytes_[:, :width]
+
+
+def _field_values(cols: Sequence[Column]) -> List[jax.Array]:
+    """u32[n] value array per field (same order as _field_widths); every
+    value already fits its declared bit width."""
+    vals: List[jax.Array] = []
+    for c in cols:
+        vals.append(c.validity.astype(jnp.uint32))
+        if c.is_string:
+            vals.extend(_pack_string_data(c.data))
+            vals.append(jax.lax.bitcast_convert_type(
+                c.lengths.astype(jnp.int32), jnp.uint32))
+        elif c.data.dtype == jnp.bool_:
+            vals.append(c.data.astype(jnp.uint32))
+        elif c.data.dtype.itemsize == 8:
+            w32 = jax.lax.bitcast_convert_type(c.data, jnp.uint32)  # [n, 2]
+            vals.append(w32[:, 0])
+            vals.append(w32[:, 1])
+        else:
+            bits = jax.lax.bitcast_convert_type(
+                c.data, _UINT_OF[c.data.dtype.itemsize])
+            vals.append(bits.astype(jnp.uint32))
+    return vals
+
+
+def pack_plane(cols: Sequence[Column]) -> jax.Array:
+    """Bit-pack the columns' buffers into one uint32[rows, words] plane.
+    Bit-exact round trip with unpack_plane (floats travel as raw bits, so
+    NaN payloads and -0.0 survive)."""
+    widths = _field_widths(cols)
+    slots, nwords = _layout(widths)
+    n = cols[0].data.shape[0]
+    words: List[Optional[jax.Array]] = [None] * nwords
+    for (word, shift, _bits), v in zip(slots, _field_values(cols)):
+        sh = v if shift == 0 else (v << jnp.uint32(shift))
+        words[word] = sh if words[word] is None else (words[word] | sh)
+    if nwords == 0:
+        return jnp.zeros((n, 0), jnp.uint32)
+    return jnp.stack([w for w in words], axis=1)
+
+
+def unpack_plane(plane: jax.Array, like: Sequence[Column],
+                 valid_mask: Optional[jax.Array] = None) -> Tuple[Column, ...]:
+    """Decode a packed plane back into Columns with ``like``'s schema
+    (dtypes, string widths).  ``valid_mask`` ANDs into every column's
+    validity and zeroes masked rows' data/lengths — the exact masking
+    Column.take applies, so packed and per-buffer exchanges produce
+    bit-identical shards."""
+    widths = _field_widths(like)
+    slots, nwords = _layout(widths)
+    assert plane.shape[1] == nwords, (plane.shape, nwords)
+    it = iter(slots)
+
+    def field() -> jax.Array:
+        word, shift, bits = next(it)
+        v = plane[:, word]
+        if shift:
+            v = v >> jnp.uint32(shift)
+        if bits < 32:
+            v = v & jnp.uint32((1 << bits) - 1)
+        return v
+
+    out: List[Column] = []
+    for c in like:
+        validity = field().astype(jnp.bool_)
+        lengths = None
+        if c.is_string:
+            words = [field() for _ in range(_string_word_count(c))]
+            data = (_unpack_string_data(words, c.string_width) if words
+                    else jnp.zeros((plane.shape[0], c.string_width),
+                                   jnp.uint8))
+            lengths = jax.lax.bitcast_convert_type(field(), jnp.int32)
+        elif c.data.dtype == jnp.bool_:
+            data = field().astype(jnp.bool_)
+        elif c.data.dtype.itemsize == 8:
+            pair = jnp.stack([field(), field()], axis=1)        # [n, 2]
+            data = jax.lax.bitcast_convert_type(
+                jax.lax.bitcast_convert_type(pair, jnp.uint64), c.data.dtype)
+        else:
+            w = c.data.dtype.itemsize
+            data = jax.lax.bitcast_convert_type(
+                field().astype(_UINT_OF[w]), c.data.dtype)
+        if valid_mask is not None:
+            validity = validity & valid_mask
+            zero = jnp.zeros((), data.dtype)
+            data = jnp.where(validity[:, None] if data.ndim == 2 else validity,
+                             data, zero)
+            if lengths is not None:
+                lengths = jnp.where(validity, lengths, 0)
+        out.append(Column(data, validity, lengths, c.dtype))
+    return tuple(out)

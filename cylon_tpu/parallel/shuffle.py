@@ -14,9 +14,13 @@ loops, here the whole exchange is ONE jit program per shard:
 2. per-target counts via segment-sum; an ``all_gather`` of the count row
    replaces the length-header handshake (the receiver "pre-allocation" is
    the static bucket size),
-3. rows are laid into fixed-size per-target buckets and exchanged with one
-   tiled ``lax.all_to_all`` per buffer over ICI/DCN,
-4. received buckets are compacted to the front with one searchsorted-gather,
+3. rows are laid into fixed-size per-target buckets and exchanged over
+   ICI/DCN — by default on TPU as ONE tiled ``lax.all_to_all`` over a
+   single bit-packed u32 plane carrying every column's data/validity/
+   lengths (``parallel/plane.py``; ``CYLON_TPU_SHUFFLE_PACK`` gates it),
+   otherwise one collective per buffer,
+4. received buckets are compacted to the front with one searchsorted-gather
+   (on the plane when packed — one gather total instead of one per buffer),
    yielding a front-packed shard + new row count.
 
 Raggedness is the hard part on TPU (static shapes): bucket size is a static
@@ -35,6 +39,7 @@ import jax.numpy as jnp
 from ..column import Column
 from ..ops import compact as compact_mod
 from . import collectives
+from . import plane as plane_mod
 
 
 # Alphabet width above which the per-target unroll (_perm_by_target) and
@@ -120,6 +125,12 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
     dropped, so callers size it from the count matrix (plan_shuffle) or use a
     safe bound (shard capacity).
     Returns (columns, new_count) with per-shard capacity ``out_capacity``.
+
+    Exchange realization (``plane.pack_enabled()``, read at trace time):
+    packed — every column's data/validity/lengths bit-packed into one u32
+    plane, ONE ``all_to_all`` total, bucket-lay/compaction gathers run once
+    on the plane; per-buffer — one collective and one gather pair per
+    buffer.  Both produce bit-identical shards (tests/test_shuffle_pack.py).
     """
     cap = cols[0].data.shape[0]
 
@@ -136,16 +147,6 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
     src_sorted = jnp.take(start, t) + k
     send_valid = k < jnp.take(counts, t)
     src = jnp.take(perm_t, jnp.clip(src_sorted, 0, cap - 1))
-    send_cols = tuple(c.take(src, valid_mask=send_valid) for c in cols)
-
-    # exchange: one tiled all_to_all per buffer (data/validity/lengths) —
-    # the whole ArrowAllToAll machinery in one collective
-    recv_cols = tuple(
-        Column(collectives.all_to_all(c.data),
-               collectives.all_to_all(c.validity),
-               None if c.lengths is None else collectives.all_to_all(c.lengths),
-               c.dtype)
-        for c in send_cols)
 
     # count matrix row exchange replaces the length-header protocol
     cm = collectives.allgather(counts, axis=0).reshape(world, world)
@@ -154,16 +155,37 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
     csum = jnp.cumsum(incoming, dtype=jnp.int32)
     total = csum[-1]
 
-    # compact the received buckets to the front
+    # front-pack the received buckets: slot o2 <- bucket s, offset within
     o2 = jnp.arange(out_capacity, dtype=jnp.int32)
     s = jnp.clip(jnp.searchsorted(csum, o2, side="right").astype(jnp.int32),
                  0, world - 1)
     within = o2 - (jnp.take(csum, s) - jnp.take(incoming, s))
-    src2 = s * bucket + within
+    src2 = jnp.clip(s * bucket + within, 0, world * bucket - 1)
     valid2 = o2 < total
-    out_cols = tuple(
-        c.take(jnp.clip(src2, 0, world * bucket - 1), valid_mask=valid2)
-        for c in recv_cols)
+
+    if plane_mod.pack_enabled():
+        # ONE collective for the whole table: pack at shard capacity,
+        # bucket-lay the plane (single gather), exchange, compact (single
+        # gather), decode with the tail mask
+        packed = plane_mod.pack_plane(cols)
+        send_plane = jnp.where(send_valid[:, None],
+                               jnp.take(packed, src, axis=0), 0)
+        recv_plane = collectives.all_to_all(send_plane)
+        out_plane = jnp.take(recv_plane, src2, axis=0)
+        return plane_mod.unpack_plane(out_plane, cols,
+                                      valid_mask=valid2), total
+
+    # per-buffer exchange: one tiled all_to_all per buffer
+    # (data/validity/lengths) — the whole ArrowAllToAll machinery, but
+    # O(buffers x columns) collective launches
+    send_cols = tuple(c.take(src, valid_mask=send_valid) for c in cols)
+    recv_cols = tuple(
+        Column(collectives.all_to_all(c.data),
+               collectives.all_to_all(c.validity),
+               None if c.lengths is None else collectives.all_to_all(c.lengths),
+               c.dtype)
+        for c in send_cols)
+    out_cols = tuple(c.take(src2, valid_mask=valid2) for c in recv_cols)
     return out_cols, total
 
 
@@ -212,6 +234,12 @@ def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
     reuse the targets pass that sized ``out_capacity`` — the reference
     similarly partitions once and streams only what exists
     (cpp/src/cylon/arrow/arrow_all_to_all.cpp:24-236).
+
+    Exchange realization (``plane.pack_enabled()``, read at trace time):
+    packed — the whole table travels as one bit-packed u32 plane through
+    ONE ``ragged_all_to_all`` (the target-sort gather also runs once, on
+    the plane); per-buffer — one collective and one sort-gather per
+    buffer.  Bit-identical outputs either way.
     """
     cap = cols[0].data.shape[0]
 
@@ -225,7 +253,19 @@ def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
     me = collectives.my_rank()
     recv_sizes, output_offsets, total = ragged_plan(cm, me)
 
-    from ..context import PARTITION_AXIS
+    if plane_mod.pack_enabled():
+        packed = plane_mod.pack_plane(cols)
+        sorted_plane = jnp.take(packed, perm_t, axis=0)
+        out = jnp.zeros((out_capacity, packed.shape[1]), packed.dtype)
+        got = collectives.ragged_all_to_all(
+            sorted_plane, out, input_offsets, counts, output_offsets,
+            recv_sizes)
+        # NO mask on decode: the per-buffer path below moves raw buffers
+        # (a null row's bytes pass through untouched), and the plane must
+        # stay bit-identical to it; rows past ``total`` decode from the
+        # zeros of ``out`` — validity False, zero data — exactly like the
+        # unwritten tail of the per-buffer outputs
+        return plane_mod.unpack_plane(got, cols), total
 
     def exchange(buf):
         squeeze = buf.ndim == 1
@@ -236,9 +276,9 @@ def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
             buf = buf.astype(jnp.uint8)
         sorted_buf = jnp.take(buf, perm_t, axis=0)
         out = jnp.zeros((out_capacity,) + buf.shape[1:], buf.dtype)
-        got = jax.lax.ragged_all_to_all(
+        got = collectives.ragged_all_to_all(
             sorted_buf, out, input_offsets, counts, output_offsets,
-            recv_sizes, axis_name=PARTITION_AXIS)
+            recv_sizes)
         if orig == jnp.bool_:
             got = got.astype(jnp.bool_)
         return got[:, 0] if squeeze else got

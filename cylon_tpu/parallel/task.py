@@ -109,6 +109,7 @@ def _plan_shuffle(t, plan: LogicalTaskPlan):
     of ArrowTaskAllToAll::insert routing through plan.worker_num_of)."""
     from ..table import Table
     from . import ops as par_ops
+    from . import plane as plane_mod
     from . import shuffle as shuffle_mod
 
     world = t.num_shards
@@ -145,6 +146,8 @@ def _plan_shuffle(t, plan: LogicalTaskPlan):
             tt.columns, tt.row_counts[0], tgt, world, bucket, out_cap)
         return Table(cols, jnp.reshape(total, (1,)), names, ctx)
 
+    # trace-time knob -> cache key (same discipline as parallel.ops._shuffled)
     return par_ops._shard_map(ctx, fn,
-                              ("task_shuffle", lut_key, bucket, out_cap),
+                              ("task_shuffle", lut_key, bucket, out_cap,
+                               plane_mod.pack_enabled()),
                               par_ops._shapes_key(t))(t)

@@ -5,9 +5,26 @@
 #   tools/full_tree_cold.sh [outfile]
 # Exit 0 = no crash (suite green); 139/134 = the repro, with the dying
 # test visible at the tail of the log.
+#
+# VERSION PIN (VERDICT round-5 item 7): the cumulative-compiler SIGSEGV
+# was observed under jax 0.9.0 (bundled jaxlib); the repro was last run
+# green (no crash) under the versions pinned below.  A jax/jaxlib bump
+# invalidates both facts at once — tests/test_packaging.py carries a
+# version-pin canary that fails deliberately on any bump, pointing here
+# and at tools/segv_canary.sh (the cheap expect-pass prefix recipe) so
+# the crash can never resurface as a mystery.
+PINNED_JAX="0.4.37"
+PINNED_JAXLIB="0.4.36"
+CRASH_OBSERVED_UNDER="jax 0.9.0 (bundled jaxlib)"
 set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-/tmp/full_tree_cold.log}
+live=$(python -c "import jax, jaxlib; print(jax.__version__, jaxlib.__version__)" 2>/dev/null)
+if [ "$live" != "$PINNED_JAX $PINNED_JAXLIB" ]; then
+  echo "WARNING: jax/jaxlib = '$live' != pinned '$PINNED_JAX $PINNED_JAXLIB'" >&2
+  echo "         (SIGSEGV originally observed under $CRASH_OBSERVED_UNDER;" >&2
+  echo "         re-run this repro and tools/segv_canary.sh, then update the pin)" >&2
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

@@ -148,4 +148,36 @@ timed("pallas scan_1d cumsum f32",
 timed("pallas scan_1d cummin i32 rev",
       lambda x: pallas_scan.scan_1d(x.astype(jnp.int32), "min",
                                     reverse=True), a, traffic_bytes=4 * B4)
+
+# ISSUE-2 tentpole: the packed-exchange plane's LOCAL cost — pack + one
+# plane gather + unpack vs the 12 per-buffer gathers it replaces (6 data
+# + 6 validity; the collective-launch saving itself needs a mesh —
+# scaling_pack0/1 in the battery measures that).  6-column numeric
+# schema, 5 plane words.
+from cylon_tpu import column as colmod  # noqa: E402
+from cylon_tpu.parallel import plane as plane_mod  # noqa: E402
+
+cols6 = (
+    colmod.from_numpy(np.asarray(a).view(np.int32)),
+    colmod.from_numpy(np.asarray(c)),
+    colmod.from_numpy((np.asarray(a) & 1).astype(bool)),
+    colmod.from_numpy(np.asarray(a).astype(np.int8)),
+    colmod.from_numpy(np.asarray(a).astype(np.int16)),
+    colmod.from_numpy(np.asarray(c).astype(np.float64)),
+)
+ROW_B = 4 + 4 + 1 + 1 + 2 + 8 + 6  # data + validity bytes per row
+W6 = plane_mod.plane_words(cols6)
+live = jnp.asarray(np.arange(N) < int(N * 0.9))
+timed(f"pack_plane 6-col ({W6} words)",
+      lambda cs: plane_mod.pack_plane(cs), cols6,
+      traffic_bytes=(ROW_B + 4 * W6) * N)
+packed6 = jax.jit(plane_mod.pack_plane)(cols6)
+timed("plane gather + unpack (packed)",
+      lambda p, i, m, cs: plane_mod.unpack_plane(
+          jnp.take(p, i, axis=0), cs, valid_mask=m),
+      packed6, perm, live, cols6,
+      traffic_bytes=(3 * 4 * W6 + ROW_B) * N)
+timed("per-buffer gathers (12 buffers)",
+      lambda cs, i, m: tuple(col.take(i, valid_mask=m) for col in cs),
+      cols6, perm, live, traffic_bytes=(2 * ROW_B + 4 * len(cols6)) * N)
 print("done", flush=True)

@@ -275,6 +275,39 @@ for _label, _mode in (("pipeline_groupby (segsum prefix)", "prefix"),
     _mode_variant(_label, segments.set_segsum, _mode, _gb_stage,
                   (joined[0], joined[1]), out_cap * 4 * 8)
 
+# -- shuffle exchange, local half: packed plane vs per-buffer --------------
+# ISSUE-2 tentpole A/B arm.  The collective-launch saving needs a mesh
+# (battery step 7d's CPU-mesh scaling A/B); what the chip must answer is
+# whether pack + ONE plane gather + unpack beats the per-buffer gathers
+# on the same rows — the local half of shuffle_shard under either value
+# of CYLON_TPU_SHUFFLE_PACK.
+from cylon_tpu.parallel import plane as plane_mod  # noqa: E402
+
+cols4 = cols_l + cols_r
+perm_sh = jnp.asarray(rng.permutation(ROWS).astype(np.int32))
+live_sh = jnp.asarray(np.arange(ROWS) < int(ROWS * 0.9))
+W4 = plane_mod.plane_words(cols4)
+
+
+@jax.jit
+def shuffle_local_packed(cs, idx, m):
+    p = plane_mod.pack_plane(cs)
+    return plane_mod.unpack_plane(jnp.take(p, idx, axis=0), cs,
+                                  valid_mask=m)
+
+
+@jax.jit
+def shuffle_local_perbuf(cs, idx, m):
+    return tuple(col.take(idx, valid_mask=m) for col in cs)
+
+
+# per row: 2x(i32+f32 data) + 4 validity bytes in; gathered copy out
+_SHUF_B = (4 + 4) * 2 + 4
+timed(f"shuffle local half PACKED ({W4} words)", shuffle_local_packed,
+      cols4, perm_sh, live_sh, traffic_bytes=(_SHUF_B + 3 * 4 * W4) * ROWS)
+timed("shuffle local half per-buffer (8 bufs)", shuffle_local_perbuf,
+      cols4, perm_sh, live_sh, traffic_bytes=2 * _SHUF_B * ROWS)
+
 # -- fused end-to-end ------------------------------------------------------
 pipeline = _bench.make_bench_pipeline(out_cap, "sort")  # THE bench program
 timed("FULL fused pipeline", pipeline, cols_l, count, cols_r, count,

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Cheap expect-PASS canary for the pinned XLA:CPU cumulative-compiler
+# SIGSEGV (PERF.md "Round-5 addendum": compiling the
+# test_keys_paths.py lexsort crashes ONLY after the whole preceding
+# alphabetical test prefix compiled in one cache-cold process; neither
+# half alone triggers it).  This runs exactly that crashing prefix
+# recipe — every test file alphabetically <= tests/test_keys_paths.py,
+# one process, compile cache disabled — and expects it to pass.
+#
+# Run it after any jax/jaxlib version change (the version-pin canary in
+# tests/test_packaging.py fires on a bump and points here): exit 0 means
+# the compiler bug did not resurface under the new version; 139/134 is
+# the crash, caught deliberately instead of as a CI mystery.  Usage:
+#   tools/segv_canary.sh [outfile]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/segv_canary.log}
+FILES=$(ls tests/test_*.py | sort | awk '$0<="tests/test_keys_paths.py"')
+echo "[canary] prefix: $(echo "$FILES" | wc -l) files through test_keys_paths.py" >&2
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
+    timeout 7200 python -m pytest $FILES -q -m 'not slow' \
+    -p no:cacheprovider > "$OUT" 2>&1
+rc=$?
+echo "[canary] rc=$rc; tail:" >&2
+tail -3 "$OUT" >&2
+if [ $rc -eq 0 ]; then
+  echo "[canary] PASS — the pinned compiler SIGSEGV did not resurface" >&2
+else
+  echo "[canary] FAIL — see $OUT; if rc is 139/134 the upstream XLA:CPU" >&2
+  echo "         compiler crash is back under this jax/jaxlib (PERF.md" >&2
+  echo "         round-5 addendum has the bisect matrix)" >&2
+fi
+exit $rc

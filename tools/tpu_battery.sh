@@ -20,6 +20,39 @@ OUT=${1:-/tmp/battery}
 mkdir -p "$OUT"
 log() { echo "[battery $(date +%H:%M:%S)] $*"; }
 
+# Commit whatever $OUT holds RIGHT NOW (no-op when $OUT is outside the
+# repo).  Called after step 0 and again at the end: four rounds of tunnel
+# outage taught that a window can close at any second, so the first live
+# artifact must become durable the moment it exists.
+commit_artifacts() {
+  local msg=$1
+  local out_abs
+  out_abs=$(realpath "$OUT" 2>/dev/null || echo "$OUT")
+  case "$out_abs" in
+    "$PWD"/*)
+      if git add -A "$out_abs" 2>/dev/null \
+        && git commit -m "$msg $(date -u +%Y-%m-%dT%H:%MZ)" \
+           -- "$out_abs" >/dev/null 2>&1; then
+        log "artifacts committed ($msg)"
+      else
+        # unstage so a later unrelated commit cannot sweep these in
+        git reset -q -- "$out_abs" 2>/dev/null
+        log "artifact commit skipped ($msg)"
+      fi
+      ;;
+    *) log "artifacts outside repo; not committed" ;;
+  esac
+}
+
+log "0/9 QUICK live bench at 16M rows/side (~2-4 min, fingerprint-stamped)"
+# VERDICT round-5 item 1: a 5-minute window must still yield a live
+# current-tree number.  Committed IMMEDIATELY below, before the 1500 s
+# headline step gets a chance to outlive the window.
+CYLON_BENCH_ROWS=16777216 CYLON_BENCH_BUDGET_S=240 timeout 300 python bench.py \
+    > "$OUT/bench_step0.json" 2> "$OUT/bench_step0.log"
+log "bench step0 rc=$? $(head -c 200 "$OUT/bench_step0.json" 2>/dev/null)"
+commit_artifacts "TPU battery step0 quick bench"
+
 log "1/9 bench (DEFAULT = sort-realized permutations on TPU) — headline"
 CYLON_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
     > "$OUT/bench_permsort.json" 2> "$OUT/bench_permsort.log"
@@ -92,6 +125,21 @@ CYLON_TPU_SCAN=pallas CYLON_BENCH_SKIP=1 CYLON_BENCH_BUDGET_S=1500 \
     > "$OUT/bench_scanpallas.json" 2> "$OUT/bench_scanpallas.log"
 log "bench scanpallas rc=$? $(head -c 200 "$OUT/bench_scanpallas.json" 2>/dev/null)"
 
+log "7d/9 packed-vs-per-buffer shuffle exchange A/B (CYLON_TPU_SHUFFLE_PACK)"
+# Tentpole knob (ISSUE 2): the local half of the exchange (pack + plane
+# gathers vs per-buffer gathers) is profiled on-chip by the profile step's
+# shuffle arm and tools/microbench.py; the collective-launch effect needs a
+# mesh, so the A/B here rides the 8-virtual-device CPU mesh (valid on any
+# host, tunnel included) — keep-or-retire evidence either way.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    CYLON_TPU_SHUFFLE_PACK=0 timeout 900 python -m examples.scaling 131072 weak \
+    > "$OUT/scaling_pack0.json" 2> "$OUT/scaling_pack0.log"
+log "scaling pack=0 rc=$?"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    CYLON_TPU_SHUFFLE_PACK=1 timeout 900 python -m examples.scaling 131072 weak \
+    > "$OUT/scaling_pack1.json" 2> "$OUT/scaling_pack1.log"
+log "scaling pack=1 rc=$?"
+
 log "8/9 kernel smoke"
 timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"
 log "smoke rc=$?"
@@ -102,21 +150,4 @@ timeout 3600 python -m examples.run_baselines full \
 log "baselines rc=$?"
 log "done; artifacts in $OUT"
 
-# Promote: if $OUT lives inside the repo, commit the captured artifacts
-# immediately — three rounds of tunnel outage taught that hardware
-# numbers must become durable the moment they exist, not at session end.
-OUT_ABS=$(realpath "$OUT" 2>/dev/null || echo "$OUT")
-case "$OUT_ABS" in
-  "$PWD"/*)
-    if git add -A "$OUT_ABS" 2>/dev/null \
-      && git commit -m "TPU battery artifacts: $(basename "$OUT_ABS") $(date -u +%Y-%m-%dT%H:%MZ)" \
-         -- "$OUT_ABS" >/dev/null 2>&1; then
-      log "artifacts committed"
-    else
-      # unstage so a later unrelated commit cannot sweep these in
-      git reset -q -- "$OUT_ABS" 2>/dev/null
-      log "artifact commit skipped"
-    fi
-    ;;
-  *) log "artifacts outside repo; not committed" ;;
-esac
+commit_artifacts "TPU battery artifacts: $(basename "$OUT")"
