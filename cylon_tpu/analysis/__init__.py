@@ -1,0 +1,33 @@
+"""cylint — repo-native static analysis for SPMD trace-safety invariants.
+
+The compiler cannot see the invariants this package enforces; until now
+they lived in reviewer memory plus one hand-written jaxpr assertion:
+
+- every rank must trace the same program (the BSP shuffle model), so a
+  host sync inside a jitted/shard_map body is a hang or a desync waiting
+  to happen (rule CY101);
+- every ``CYLON_TPU_*`` knob is read through the declarative registry in
+  ``cylon_tpu.config`` — a stray ``os.environ`` read is invisible to the
+  jit-plan cache keys and to the README reference table (rule CY102);
+- a trace-time knob consumed inside a jit-plan body must participate in
+  that plan's cache key, or flipping the knob serves a program traced
+  under the other realization — the exact bug class
+  ``CYLON_TPU_SHUFFLE_PACK`` had to be hand-keyed against in PR 2
+  (rule CY103);
+- collectives must never sit inside a retry wrapper unless the policy is
+  the context's ``collective_retry_policy`` — single-host re-entry of a
+  collective desyncs multi-process meshes (PR 1's invariant, rule CY104);
+- a bare/overbroad except that ignores the caught exception swallows the
+  ``Status`` classification the resilience layer keys on (rule CY105).
+
+Level 2 (``cylon_tpu.analysis.budgets``) traces the shuffle,
+task-shuffle, hash-partition and chunked-pass entry points at a small
+canonical shape grid and pins their collective-launch counts against
+committed golden files — a silent 1 -> 13 collective regression fails
+tier-1 instead of waiting for TPU bench time (rules CY201/CY202).
+
+Run ``python -m cylon_tpu.analysis cylon_tpu/`` (alias ``tools/cylint``).
+Suppress per line with ``# cylint: disable=CY1xx -- <justification>``;
+the justification text is mandatory (rule CY001).
+"""
+from .astlint import Finding, RULES, scan_paths  # noqa: F401

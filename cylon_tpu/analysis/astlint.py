@@ -1,0 +1,788 @@
+"""Level-1 (AST) rules of cylint.
+
+Pure-stdlib AST analysis — importable and runnable with no jax present.
+The pass is two-phase: phase 1 parses every file into a ``_Module`` and
+collects per-function facts (resolved call edges, knob-accessor uses,
+env reads, traced-root markers, plan-builder shape); phase 2 propagates
+traced-ness and knob use over the cross-module call graph and emits
+findings.
+
+Scope notes (what the analysis can and cannot prove):
+
+- Call edges resolve through module aliases (``from . import plane as
+  plane_mod``) and bare local names; method calls on objects
+  (``t.shuffle(...)``) do not resolve — reachability through them is out
+  of scope.
+- CY101's tracer taint starts at ``jax.*``/``jnp.*``/collectives calls,
+  not at function parameters: a value is considered a tracer once it has
+  passed through the jax namespace.  That trades a class of
+  param-direct hazards for near-zero false positives on shape/static
+  branches (``if world + 1 > cutoff``), which are pervasive and legal.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .. import config
+
+RULES: Dict[str, str] = {
+    "CY001": "cylint suppression without a justification",
+    "CY101": "host-sync hazard inside a traced (jit/shard_map) body",
+    "CY102": "environment read outside the knob registry",
+    "CY103": "trace-time knob missing from a jit-plan cache key",
+    "CY104": "retry wrapper lexically enclosing a collective",
+    "CY105": "swallowed exception classification",
+    "CY201": "missing collective-budget golden file",
+    "CY202": "collective-budget regression against the golden file",
+}
+
+#: files allowed to read os.environ directly: the registry itself, and
+#: the compile-cache enabler (must work before the package is importable)
+ENV_READ_ALLOWED = ("cylon_tpu/config.py", "cylon_tpu/utils/compile_cache.py")
+
+#: collective call names (final identifier) for CY104 reachability
+COLLECTIVE_NAMES = frozenset({
+    "all_to_all", "ragged_all_to_all", "all_gather", "allgather",
+    "allreduce_sum", "allreduce_min", "allreduce_max", "psum",
+    "ppermute", "collective_permute", "pmax", "pmin",
+})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*cylint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--\s*(\S.*))?\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    msg: str
+    hint: str = ""
+
+    def render(self) -> str:
+        s = f"{self.path}:{self.line}: {self.rule} {self.msg}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+# ---------------------------------------------------------------------------
+# phase 1: per-module facts
+# ---------------------------------------------------------------------------
+
+
+def _module_name(path: str) -> str:
+    parts = path.replace("\\", "/").split("/")
+    if "cylon_tpu" in parts:
+        parts = parts[parts.index("cylon_tpu"):]
+    else:
+        parts = parts[-1:]
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    elif parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts)
+
+
+@dataclass
+class _Func:
+    qual: str                    # module.name (nested defs flattened by name)
+    module: str
+    node: ast.AST                # FunctionDef | AsyncFunctionDef | Lambda
+    lineno: int
+    calls: Set[str] = field(default_factory=set)        # resolved quals
+    call_finals: Set[str] = field(default_factory=set)  # final identifiers
+    knobs: Set[str] = field(default_factory=set)        # knob names used
+    traced_root: bool = False
+    # plan-builder shape: param index that gets jitted, where the cache key
+    # arrives (positional index, or keyword-only), and whether the key
+    # computation includes trace_cache_token()
+    builder_fn_idx: Optional[int] = None
+    builder_key_idx: Optional[int] = None
+    builder_key_kw: bool = False
+    key_complete: bool = False
+
+
+@dataclass
+class _Module:
+    path: str
+    name: str
+    tree: ast.Module
+    lines: List[str]
+    aliases: Dict[str, str] = field(default_factory=dict)  # local -> qual
+    funcs: Dict[str, _Func] = field(default_factory=dict)  # simple name -> f
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    findings: List[Finding] = field(default_factory=list)
+
+
+def _accessor_map() -> Dict[str, str]:
+    """qualified accessor -> knob name, from the registry's declarative
+    ``accessors`` column."""
+    return {acc: k.name
+            for k in config.KNOBS.values() for acc in k.accessors}
+
+
+_ACC_BY_QUAL = _accessor_map()
+_TRACE_KNOBS = frozenset(k.name for k in config.KNOBS.values()
+                         if k.scope == config.TRACE)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _resolve(dotted: Optional[str], aliases: Dict[str, str]) -> Optional[str]:
+    """Rewrite the leading alias of a dotted path to its import target."""
+    if not dotted:
+        return None
+    head, _, rest = dotted.partition(".")
+    base = aliases.get(head)
+    if base is None:
+        return dotted
+    return base + ("." + rest if rest else "")
+
+
+def _collect_aliases(tree: ast.Module, module: str,
+                     is_package: bool) -> Dict[str, str]:
+    # level-1 relative imports resolve against the containing package: the
+    # module itself when this file IS a package (__init__.py), else its
+    # parent
+    if is_package:
+        pkg = module
+    else:
+        pkg = module.rsplit(".", 1)[0] if "." in module else module
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.partition(".")[0]] = (
+                    a.name if a.asname else a.name.partition(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                parts = pkg.split(".")
+                parts = parts[: len(parts) - (node.level - 1)]
+                base = ".".join(parts)
+                if node.module:
+                    base += "." + node.module
+            else:
+                base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = (
+                    f"{base}.{a.name}" if base else a.name)
+    return aliases
+
+
+def _is_jit_like(callee: Optional[str], final: str) -> bool:
+    """Calls that turn their first function argument into a traced body."""
+    if final in ("jit", "shard_map", "make_jaxpr", "pjit", "vmap", "pmap",
+                 "grad", "value_and_grad", "checkpoint", "remat"):
+        return True
+    return bool(callee and callee.startswith("jax.") and final == "jit")
+
+
+def _first_fn_arg(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def _knob_of_call(call: ast.Call, aliases: Dict[str, str],
+                  module: str) -> Optional[str]:
+    """Knob name a call consumes: a registry accessor, or a literal
+    ``config.knob("NAME")`` / ``knob_raw("NAME")``."""
+    dotted = _dotted(call.func)
+    resolved = _resolve(dotted, aliases)
+    final = (dotted or "").rsplit(".", 1)[-1]
+    if resolved in _ACC_BY_QUAL:
+        return _ACC_BY_QUAL[resolved]
+    # bare local call to an accessor defined in this very module
+    if dotted and "." not in dotted and f"{module}.{dotted}" in _ACC_BY_QUAL:
+        return _ACC_BY_QUAL[f"{module}.{dotted}"]
+    if final in ("knob", "knob_raw") and call.args:
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return None
+
+
+class _FuncScanner(ast.NodeVisitor):
+    """Fills one _Func's call edges, knob uses and builder shape."""
+
+    def __init__(self, func: _Func, mod: _Module):
+        self.f = func
+        self.mod = mod
+        params, kwonly = [], []
+        node = func.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = [a.arg for a in node.args.posonlyargs + node.args.args]
+            kwonly = [a.arg for a in node.args.kwonlyargs]
+        self.params = params
+        self.kwonly = kwonly
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self.f.node:
+            self.generic_visit(node)
+        # nested defs get their own _Func; don't descend here
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        if node is self.f.node:
+            self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        resolved = _resolve(dotted, self.mod.aliases)
+        final = (dotted or "").rsplit(".", 1)[-1]
+        if final:
+            self.f.call_finals.add(final)
+        if dotted and "." not in dotted:
+            self.f.calls.add(f"{self.mod.name}.{dotted}")
+        elif resolved:
+            self.f.calls.add(resolved)
+        knob = _knob_of_call(node, self.mod.aliases, self.mod.name)
+        if knob:
+            self.f.knobs.add(knob)
+        if final == "trace_cache_token":
+            self.f.key_complete = True
+        # builder shape: one of OUR params handed to a jit-like call
+        if _is_jit_like(resolved, final):
+            fn = _first_fn_arg(node)
+            if fn in self.params:
+                self.f.builder_fn_idx = self.params.index(fn)
+                if "key" in self.params:
+                    self.f.builder_key_idx = self.params.index("key")
+                elif "key" in self.kwonly:
+                    self.f.builder_key_kw = True
+        self.generic_visit(node)
+
+
+def _parse_module(path: str) -> Optional[_Module]:
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        mod = _Module(path, _module_name(path), ast.Module(body=[],
+                      type_ignores=[]), src.splitlines())
+        mod.findings.append(Finding("CY001", path, e.lineno or 1,
+                                    f"file does not parse: {e.msg}"))
+        return mod
+    mod = _Module(path, _module_name(path), tree, src.splitlines())
+    mod.aliases = _collect_aliases(
+        tree, mod.name, path.replace("\\", "/").endswith("/__init__.py"))
+
+    for i, line in enumerate(mod.lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        if not m.group(2):
+            mod.findings.append(Finding(
+                "CY001", path, i,
+                f"suppression of {', '.join(sorted(rules))} carries no "
+                f"justification",
+                "write `# cylint: disable=RULE -- <why this is safe>`"))
+            continue
+        mod.suppressions[i] = rules
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            f = _Func(qual=f"{mod.name}.{node.name}", module=mod.name,
+                      node=node, lineno=node.lineno)
+            for dec in node.decorator_list:
+                d = _resolve(_dotted(dec), mod.aliases) or ""
+                call_d = ""
+                if isinstance(dec, ast.Call):
+                    call_d = _resolve(_dotted(dec.func), mod.aliases) or ""
+                    for a in dec.args:
+                        inner = _resolve(_dotted(a), mod.aliases) or ""
+                        if inner.endswith("jit") or inner.endswith("shard_map"):
+                            f.traced_root = True
+                if (d.endswith(".jit") or d == "jit"
+                        or call_d.endswith(".jit") or call_d == "jit"):
+                    f.traced_root = True
+            _FuncScanner(f, mod).visit(node)
+            # last def under a name wins for resolution; collisions are
+            # rare (nested helper fns) and union-ed via call_finals anyway
+            mod.funcs[node.name] = f
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# phase 2: cross-module propagation
+# ---------------------------------------------------------------------------
+
+
+class _Program:
+    def __init__(self, modules: Sequence[_Module]):
+        self.modules = list(modules)
+        self.by_qual: Dict[str, _Func] = {}
+        for m in self.modules:
+            for f in m.funcs.values():
+                self.by_qual[f.qual] = f
+
+    def reachable(self, root: _Func) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [root.qual]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            f = self.by_qual.get(q)
+            if f is None:
+                continue
+            stack.extend(f.calls)
+        return seen
+
+    def knobs_of(self, root: _Func) -> Set[str]:
+        out: Set[str] = set()
+        for q in self.reachable(root):
+            f = self.by_qual.get(q)
+            if f is not None:
+                out |= f.knobs
+        return out
+
+    def collective_reach(self, root: _Func) -> Set[str]:
+        out: Set[str] = set()
+        for q in self.reachable(root):
+            f = self.by_qual.get(q)
+            if f is not None:
+                out |= f.call_finals & COLLECTIVE_NAMES
+        return out
+
+    def traced_funcs(self) -> Set[str]:
+        """Functions reachable from any traced root: decorated jits, args
+        of jit-like calls, and fn args at plan-builder call sites."""
+        roots: Set[str] = {f.qual for f in self.by_qual.values()
+                           if f.traced_root}
+        for m in self.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func)
+                resolved = _resolve(dotted, m.aliases)
+                final = (dotted or "").rsplit(".", 1)[-1]
+                fn = _first_fn_arg(node)
+                if fn and fn in m.funcs and _is_jit_like(resolved, final):
+                    roots.add(m.funcs[fn].qual)
+                b = self._builder_for(dotted, resolved, m)
+                if b is not None and b.builder_fn_idx is not None:
+                    if len(node.args) > b.builder_fn_idx:
+                        a = node.args[b.builder_fn_idx]
+                        if isinstance(a, ast.Name) and a.id in m.funcs:
+                            roots.add(m.funcs[a.id].qual)
+        seen: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            f = self.by_qual.get(q)
+            if f is not None:
+                stack.extend(f.calls)
+        return seen
+
+    def _builder_for(self, dotted: Optional[str], resolved: Optional[str],
+                     mod: _Module) -> Optional[_Func]:
+        """The plan-builder _Func a call site targets, if any."""
+        if dotted and "." not in dotted:
+            f = mod.funcs.get(dotted)
+            if f is not None and f.builder_fn_idx is not None:
+                return f
+            f = self.by_qual.get(f"{mod.name}.{dotted}")
+        else:
+            f = self.by_qual.get(resolved or "")
+        if f is not None and f.builder_fn_idx is not None:
+            return f
+        return None
+
+
+# ---------------------------------------------------------------------------
+# rule CY101: host-sync hazards under tracer taint
+# ---------------------------------------------------------------------------
+
+_JAXY_ROOTS = ("jax", "jax.numpy", "jax.lax", "jax.ops", "jax.random",
+               "cylon_tpu.parallel.collectives")
+_NUMPY_ROOTS = ("numpy",)
+
+#: array-metadata attributes: static at trace time, so reading them never
+#: yields a tracer (branching on ``x.shape``/``x.dtype`` is legal)
+_STATIC_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "itemsize", "nbytes", "weak_type"})
+
+#: jnp/jax callables that answer static dtype/shape questions, not arrays
+_STATIC_JAX_FNS = frozenset({
+    "issubdtype", "iinfo", "finfo", "result_type", "promote_types",
+    "can_cast", "isdtype", "dtype", "default_backend", "devices",
+    "device_count", "local_device_count", "process_count", "process_index"})
+
+
+class _Taint(ast.NodeVisitor):
+    def __init__(self, func: _Func, mod: _Module, out: List[Finding]):
+        self.f = func
+        self.mod = mod
+        self.out = out
+        self.tainted: Set[str] = set()
+
+    def _root_of(self, dotted: Optional[str]) -> Optional[str]:
+        if not dotted:
+            return None
+        resolved = _resolve(dotted, self.mod.aliases) or dotted
+        return resolved.rsplit(".", 1)[0] if "." in resolved else resolved
+
+    def _is_jaxy_call(self, node: ast.Call) -> bool:
+        root = self._root_of(_dotted(node.func))
+        return bool(root) and any(
+            root == r or root.startswith(r + ".") for r in _JAXY_ROOTS)
+
+    def _is_numpy_call(self, node: ast.Call) -> bool:
+        root = self._root_of(_dotted(node.func))
+        return bool(root) and any(
+            root == r or root.startswith(r + ".") for r in _NUMPY_ROOTS)
+
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        """Whether evaluating ``node`` can yield a tracer.  Recursive with
+        static barriers: array metadata (``x.shape``/``x.dtype``), static
+        jnp predicates (``jnp.issubdtype``), identity tests (``x is
+        None``) and ``len()`` are trace-time constants even when their
+        operand is a tracer."""
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._expr_tainted(node.value)
+        if isinstance(node, ast.Name):
+            return (isinstance(node.ctx, ast.Load)
+                    and node.id in self.tainted)
+        if isinstance(node, ast.Call):
+            final = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+            if final in ("len", "isinstance", "hasattr", "getattr", "range"):
+                return False
+            if self._is_jaxy_call(node):
+                return final not in _STATIC_JAX_FNS
+            return (any(self._expr_tainted(a) for a in node.args)
+                    or any(self._expr_tainted(k.value)
+                           for k in node.keywords))
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # `x is None`: structural, static at trace time
+            return (self._expr_tainted(node.left)
+                    or any(self._expr_tainted(c) for c in node.comparators))
+        return any(self._expr_tainted(c) for c in ast.iter_child_nodes(node))
+
+    def run(self) -> None:
+        body = getattr(self.f.node, "body", [])
+        if isinstance(self.f.node, ast.Lambda):
+            body = [ast.Expr(self.f.node.body)]
+        # fixpoint over straight-line taint (loops converge in 2-3 passes)
+        for _ in range(4):
+            before = len(self.tainted)
+            for stmt in body:
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Assign) and self._expr_tainted(n.value):
+                        for t in n.targets:
+                            for name in ast.walk(t):
+                                if isinstance(name, ast.Name):
+                                    self.tainted.add(name.id)
+                    elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                        if n.value is not None and self._expr_tainted(n.value):
+                            if isinstance(n.target, ast.Name):
+                                self.tainted.add(n.target.id)
+            if len(self.tainted) == before:
+                break
+        for stmt in body:
+            self.visit(stmt)
+
+    def _flag(self, node: ast.AST, what: str, hint: str) -> None:
+        self.out.append(Finding(
+            "CY101", self.mod.path, getattr(node, "lineno", self.f.lineno),
+            f"{what} inside traced body `{self.f.qual.rsplit('.', 1)[-1]}` "
+            f"forces a device sync (every rank must trace the same program)",
+            hint))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        final = (dotted or "").rsplit(".", 1)[-1]
+        args_tainted = any(self._expr_tainted(a) for a in node.args)
+        if dotted in ("float", "int", "bool") and args_tainted:
+            self._flag(node, f"`{dotted}()` on a tracer",
+                       "keep the value on device (jnp.astype / lax.convert"
+                       "_element_type) or hoist the read out of the jit")
+        elif final in ("asarray", "array") and self._is_numpy_call(node) \
+                and args_tainted:
+            self._flag(node, "`np.asarray` of a device value",
+                       "use jnp inside traced code; np.* forces __array__ "
+                       "and blocks until the device flushes")
+        elif final == "item" and isinstance(node.func, ast.Attribute) \
+                and self._expr_tainted(node.func.value):
+            self._flag(node, "`.item()` on a tracer",
+                       "return the array and read it on the host after the "
+                       "jit boundary")
+        self.generic_visit(node)
+
+    def _check_branch(self, test: ast.AST, kind: str) -> None:
+        if self._expr_tainted(test):
+            self.out.append(Finding(
+                "CY101", self.mod.path, getattr(test, "lineno", self.f.lineno),
+                f"Python `{kind}` on tracer truthiness inside traced body "
+                f"`{self.f.qual.rsplit('.', 1)[-1]}`",
+                "use jnp.where / lax.cond — a host branch reads the value "
+                "and desyncs ranks that trace the other arm"))
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_branch(node.test, "assert")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is not self.f.node:
+            return  # nested defs analyzed via their own _Func when traced
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+# ---------------------------------------------------------------------------
+# remaining per-module rules
+# ---------------------------------------------------------------------------
+
+
+def _check_env_reads(mod: _Module) -> None:
+    norm = mod.path.replace("\\", "/")
+    if any(norm.endswith(suffix) for suffix in ENV_READ_ALLOWED):
+        return
+    for node in ast.walk(mod.tree):
+        dotted = None
+        if isinstance(node, ast.Call):
+            dotted = _resolve(_dotted(node.func), mod.aliases)
+            if dotted not in ("os.environ.get", "os.getenv"):
+                continue
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            dotted = _resolve(_dotted(node.value), mod.aliases)
+            if dotted != "os.environ":
+                continue
+        elif isinstance(node, ast.Compare):
+            ok = any(_resolve(_dotted(c), mod.aliases) == "os.environ"
+                     for c in node.comparators)
+            if not (ok and any(isinstance(op, (ast.In, ast.NotIn))
+                               for op in node.ops)):
+                continue
+            dotted = "os.environ"
+        else:
+            continue
+        mod.findings.append(Finding(
+            "CY102", mod.path, node.lineno,
+            f"`{dotted}` read outside the knob registry",
+            "declare the knob in cylon_tpu.config.KNOBS and read it via "
+            "config.knob()/knob_raw(); only config.py and "
+            "utils/compile_cache.py may touch os.environ"))
+
+
+def _check_excepts(mod: _Module) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            mod.findings.append(Finding(
+                "CY105", mod.path, node.lineno,
+                "bare `except:` swallows Status classification (and "
+                "KeyboardInterrupt/SystemExit)",
+                "catch a concrete type, or `except Exception as e` and "
+                "route e through Status.from_exception"))
+            continue
+        names = {t.id for t in ast.walk(node.type) if isinstance(t, ast.Name)}
+        if not names & {"Exception", "BaseException"}:
+            continue
+        used = node.name and any(
+            isinstance(n, ast.Name) and n.id == node.name
+            for s in node.body for n in ast.walk(s))
+        reraises = any(isinstance(n, ast.Raise)
+                       for s in node.body for n in ast.walk(s))
+        if not used and not reraises:
+            mod.findings.append(Finding(
+                "CY105", mod.path, node.lineno,
+                "overbroad `except Exception` ignores the caught exception "
+                "— the Status classification (OOM vs transient vs bug) is "
+                "silently discarded",
+                "bind it (`as e`) and classify via Status.from_exception, "
+                "re-raise, or narrow the type"))
+
+
+def _check_retries(prog: _Program, mod: _Module) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        final = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+        if final != "retry_call" or not node.args:
+            continue
+        policy_ok = False
+        for kw in node.keywords:
+            if kw.arg == "policy" and any(
+                    isinstance(n, ast.Attribute)
+                    and n.attr == "collective_retry_policy"
+                    for n in ast.walk(kw.value)):
+                policy_ok = True
+        if policy_ok:
+            continue
+        target = node.args[0]
+        hit: Set[str] = set()
+        if isinstance(target, ast.Name) and target.id in mod.funcs:
+            hit = prog.collective_reach(mod.funcs[target.id])
+        elif isinstance(target, ast.Lambda):
+            finals = {(_dotted(c.func) or "").rsplit(".", 1)[-1]
+                      for c in ast.walk(target) if isinstance(c, ast.Call)}
+            hit = finals & COLLECTIVE_NAMES
+            for c in ast.walk(target):
+                if isinstance(c, ast.Call):
+                    d = _dotted(c.func)
+                    if d and "." not in d and d in mod.funcs:
+                        hit |= prog.collective_reach(mod.funcs[d])
+        if hit:
+            mod.findings.append(Finding(
+                "CY104", mod.path, node.lineno,
+                f"retry wrapper encloses collective(s) "
+                f"{', '.join(sorted(hit))} — single-host re-entry desyncs "
+                f"a multi-process mesh (PR 1 invariant)",
+                "pass policy=ctx.collective_retry_policy() (no-retry on "
+                "multi-process meshes) or move the collective out of the "
+                "retried callable"))
+
+
+def _check_plan_keys(prog: _Program, mod: _Module) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        resolved = _resolve(dotted, mod.aliases)
+        b = prog._builder_for(dotted, resolved, mod)
+        if b is None or b.key_complete:
+            continue
+        if not (b.builder_key_idx is not None or b.builder_key_kw):
+            continue
+        # the cache key at this call site: positional, or passed as key=
+        key_expr = None
+        if (b.builder_key_idx is not None
+                and len(node.args) > b.builder_key_idx):
+            key_expr = node.args[b.builder_key_idx]
+        if key_expr is None:
+            for kw in node.keywords:
+                if kw.arg == "key":
+                    key_expr = kw.value
+        if key_expr is None or len(node.args) <= b.builder_fn_idx:
+            continue
+        fn_arg = node.args[b.builder_fn_idx]
+        if not isinstance(fn_arg, ast.Name) or fn_arg.id not in mod.funcs:
+            continue
+        knobs = {k for k in prog.knobs_of(mod.funcs[fn_arg.id])
+                 if k in _TRACE_KNOBS}
+        if not knobs:
+            continue
+        covered: Set[str] = set()
+        token = False
+        for n in ast.walk(key_expr):
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func) or ""
+                fin = d.rsplit(".", 1)[-1]
+                if fin == "trace_cache_token":
+                    token = True
+                k = _knob_of_call(n, mod.aliases, mod.name)
+                if k:
+                    covered.add(k)
+            elif isinstance(n, ast.Name):
+                # a name assigned from an accessor call in this module
+                covered |= _names_bound_to_knobs(mod).get(n.id, set())
+        missing = set() if token else knobs - covered
+        if missing:
+            mod.findings.append(Finding(
+                "CY103", mod.path, node.lineno,
+                f"jit-plan cache key omits trace-time knob(s) "
+                f"{', '.join(sorted(missing))} used inside "
+                f"`{fn_arg.id}` — flipping the knob would serve a stale "
+                f"program (the CYLON_TPU_SHUFFLE_PACK bug class)",
+                "include the accessor value in the key tuple, or append "
+                "config.trace_cache_token() inside the plan builder"))
+
+
+def _names_bound_to_knobs(mod: _Module) -> Dict[str, Set[str]]:
+    cached = getattr(mod, "_knob_names", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            k = _knob_of_call(node.value, mod.aliases, mod.name)
+            if k:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.setdefault(t.id, set()).add(k)
+    mod._knob_names = out  # type: ignore[attr-defined]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    import os as _os
+
+    files: List[str] = []
+    for p in paths:
+        if _os.path.isdir(p):
+            for root, dirs, names in _os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                files.extend(_os.path.join(root, n)
+                             for n in sorted(names) if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    return files
+
+
+def scan_paths(paths: Sequence[str]) -> List[Finding]:
+    """Run every level-1 rule over the .py files under ``paths`` and
+    return surviving (non-suppressed) findings sorted by location."""
+    modules = [m for m in (_parse_module(f) for f in _iter_py_files(paths))
+               if m is not None]
+    prog = _Program(modules)
+    traced = prog.traced_funcs()
+
+    for mod in modules:
+        _check_env_reads(mod)
+        _check_excepts(mod)
+        _check_retries(prog, mod)
+        _check_plan_keys(prog, mod)
+        for f in mod.funcs.values():
+            if f.qual in traced:
+                _Taint(f, mod, mod.findings).run()
+
+    out: List[Finding] = []
+    for mod in modules:
+        for fd in mod.findings:
+            sup = mod.suppressions.get(fd.line, ())
+            if fd.rule in sup and fd.rule != "CY001":
+                continue
+            out.append(fd)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))

@@ -44,12 +44,9 @@ def max_string_width() -> int:
     otherwise inflates the whole column — the overflow policy is an error
     naming the cell, not silent truncation; callers that really want wide
     rows pass ``string_width=`` explicitly or raise the env cap."""
-    import os
+    from . import config
 
-    try:
-        return int(os.environ.get("CYLON_TPU_MAX_STRING_WIDTH", "4096"))
-    except ValueError:
-        return 4096
+    return int(config.knob("CYLON_TPU_MAX_STRING_WIDTH"))
 
 
 def _check_width(needed: int, explicit: Optional[int]) -> None:

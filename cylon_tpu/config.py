@@ -1,15 +1,29 @@
-"""Builder-style option objects.
+"""Builder-style option objects and the environment-knob registry.
 
 The reference has no global flag system; options travel as small builder
 objects (SURVEY §5): ``JoinConfig`` (cpp/src/cylon/join/join_config.hpp:22-89),
 ``SortOptions`` (table.hpp:365-373), CSV/Parquet options (under io/).  Same
 here; the IO options live in cylon_tpu.io.
+
+This module is also the ONE place the package reads ``CYLON_TPU_*``
+environment knobs (the other sanctioned reader is
+``utils/compile_cache.py``, which must work before the package imports).
+``KNOBS`` is the authoritative declarative table — name, type, default,
+scope (trace-time vs runtime), jit-plan cache-key participation — and
+``knob()`` / ``knob_raw()`` are the only accessors call sites may use.
+``cylint`` (``python -m cylon_tpu.analysis``) bans stray ``os.environ``
+reads elsewhere in the package (rule CY102) and checks that every
+trace-scope knob reachable from a jit-plan body participates in that
+plan's cache key (rule CY103) — the exact bug class
+``CYLON_TPU_SHUFFLE_PACK`` had to be hand-keyed against in PR 2.
 """
 from __future__ import annotations
 
+import contextlib
 import enum
+import os
 from dataclasses import dataclass, field
-from typing import Optional, Sequence, Tuple, Union
+from typing import Dict, Optional, Sequence, Tuple, Union
 
 
 class JoinType(enum.IntEnum):
@@ -99,3 +113,232 @@ class SortOptions:
     num_bins: int = 0        # 0 -> 16 * world_size (reference default)
     num_samples: int = 0     # 0 -> min(row_count, 4096) per shard
     nulls_first: bool = True
+
+
+# ---------------------------------------------------------------------------
+# environment-knob registry
+# ---------------------------------------------------------------------------
+
+#: scope values: "trace" — the value is read while tracing a jit program
+#: (flipping it changes the traced computation, so it must participate in
+#: every jit-plan cache key; ``trace_cache_token()`` carries them all);
+#: "runtime" — read on the host outside any trace (retry budgets, IO
+#: fallbacks, debug switches); flipping it never invalidates a compiled
+#: program.
+TRACE = "trace"
+RUNTIME = "runtime"
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One row of the declarative environment-knob table.
+
+    ``accessors`` names the package functions (dotted module-qualified)
+    through which call sites consume the knob — cylint's cache-key rule
+    (CY103) uses them to map knob *uses inside traced bodies* back to the
+    registry row.
+    """
+
+    name: str
+    kind: str                       # "str" | "int" | "float" | "bool" | "enum"
+    default: object
+    scope: str                      # TRACE | RUNTIME
+    cache_key: bool = False         # must participate in jit-plan cache keys
+    choices: Tuple[str, ...] = ()   # for kind == "enum"
+    accessors: Tuple[str, ...] = ()
+    help: str = ""
+
+
+_K = Knob
+
+KNOBS: Dict[str, Knob] = {k.name: k for k in [
+    # -- trace-scope knobs: every one of these changes the traced program --
+    _K("CYLON_TPU_SHUFFLE_PACK", "enum", "auto", TRACE, cache_key=True,
+       choices=("1", "on", "packed", "0", "off", "perbuf", "auto"),
+       accessors=("cylon_tpu.parallel.plane.pack_enabled",),
+       help="Shuffle exchange realization: one bit-packed u32 plane per "
+            "collective (packed) vs one collective per buffer per column "
+            "(perbuf); auto packs on TPU-family backends."),
+    _K("CYLON_TPU_PERMUTE", "enum", "auto", TRACE, cache_key=True,
+       choices=("scatter", "sort", "auto"),
+       accessors=("cylon_tpu.ops.compact.permute_mode",),
+       help="Permutation/compaction realization: scatter vs single-word "
+            "sort; auto sorts on TPU-family backends."),
+    _K("CYLON_TPU_INVPERM", "enum", "sort", TRACE, cache_key=True,
+       choices=("sort", "gather"),
+       accessors=("cylon_tpu.ops.compact.invperm_mode",),
+       help="Inverse-permutation apply: one multi-operand sort vs sort-"
+            "once + per-field gathers."),
+    _K("CYLON_TPU_SORT", "enum", "cmp", TRACE, cache_key=True,
+       choices=("cmp", "radix"),
+       accessors=("cylon_tpu.ops.radix.sort_mode",),
+       help="Packed fast-path sort family: lax.sort (cmp) vs the radix "
+            "kernel."),
+    _K("CYLON_TPU_RADIX_BITS", "int", 1, TRACE, cache_key=True,
+       accessors=("cylon_tpu.ops.radix.radix_bits",),
+       help="Radix digit width in bits (clamped to 1..8 at the call site)."),
+    _K("CYLON_TPU_RADIX_SCAN", "str", "", TRACE, cache_key=True,
+       accessors=("cylon_tpu.ops.radix._cumsum_i32",),
+       help="'xla' reverts the radix kernel's matmul cumsum to jnp.cumsum "
+            "for A/B."),
+    _K("CYLON_TPU_SCAN", "str", "", TRACE, cache_key=True,
+       accessors=("cylon_tpu.ops.segments._pallas_plain_scan_selected",),
+       help="'pallas' routes run_extents' cumsum/cummax/cummin through the "
+            "Pallas scan kernel."),
+    _K("CYLON_TPU_SEGSUM", "str", "", TRACE, cache_key=True,
+       accessors=("cylon_tpu.ops.segments.prefix_reductions_enabled",
+                  "cylon_tpu.ops.segments.effective_mode",
+                  "cylon_tpu.ops.segments._pallas_scan_selected"),
+       help="Segment-reduction path: prefix | pallas | scatter; unset "
+            "prefers prefix on TPU-family backends."),
+    _K("CYLON_TPU_ACCUM", "enum", "auto", TRACE, cache_key=True,
+       choices=("wide", "narrow", "auto"),
+       accessors=("cylon_tpu.precision.accumulation_mode",
+                  "cylon_tpu.precision.narrow",
+                  "cylon_tpu.precision.float_acc",
+                  "cylon_tpu.precision.float_acc_for",
+                  "cylon_tpu.precision.int_acc",
+                  "cylon_tpu.precision.count_acc"),
+       help="Accumulator widths for sums/stats: wide (f64/i64) vs narrow "
+            "(f32/i32-native); auto narrows on TPU-family backends."),
+    # -- plan-scope / runtime knobs ----------------------------------------
+    _K("CYLON_TPU_SHUFFLE", "enum", "auto", RUNTIME,
+       choices=("ragged", "bucketed", "auto"),
+       help="Exchange collective family: RaggedAllToAll vs fixed-bucket "
+            "all_to_all; auto probes the backend.  Selected at plan-build "
+            "time on the host (the two families build differently-keyed "
+            "plans, so no cache-key participation is needed)."),
+    _K("CYLON_TPU_MAX_STRING_WIDTH", "int", 4096, RUNTIME,
+       help="Widest byte matrix a string column may ingest without an "
+            "explicit string_width= (HBM guard)."),
+    _K("CYLON_TPU_ONESHOT_FALLBACK", "bool", True, RUNTIME,
+       help="Allow a single-shard one-shot op that dies of device OOM to "
+            "fall back to the chunked out-of-core engine."),
+    _K("CYLON_TPU_FALLBACK_PASSES", "int", 4, RUNTIME,
+       help="Initial pass count for the one-shot -> chunked OOM fallback."),
+    _K("CYLON_TPU_CHUNK_PRESORT", "bool", True, RUNTIME,
+       help="Pre-group host rows by pass id once (O(n)) instead of masking "
+            "per pass (O(n x passes)) in the chunked engine."),
+    _K("CYLON_TPU_PREFETCH", "bool", True, RUNTIME,
+       help="Overlap host slicing of pass p+1 with device execution of "
+            "pass p in the chunked engine."),
+    _K("CYLON_TPU_NO_NATIVE_IO", "bool", False, RUNTIME,
+       help="Disable the native (C++) CSV/Arrow fast paths; use pyarrow."),
+    _K("CYLON_TPU_NO_NATIVE", "bool", False, RUNTIME,
+       help="Disable loading the native kernel library entirely."),
+    _K("CYLON_TPU_MAX_OOM_SPLITS", "int", 4, RUNTIME,
+       help="How many times the out-of-core engine may double the pass "
+            "count before a device OOM becomes fatal."),
+    _K("CYLON_TPU_RETRY_MAX", "int", 2, RUNTIME,
+       help="Transient-failure retry budget (RetryPolicy.from_env)."),
+    _K("CYLON_TPU_RETRY_BASE_S", "float", 0.05, RUNTIME,
+       help="Base backoff seconds for transient retries."),
+    _K("CYLON_TPU_RETRY_MAX_S", "float", 2.0, RUNTIME,
+       help="Backoff ceiling seconds for transient retries."),
+    _K("CYLON_TPU_FAULT_PLAN", "str", "", RUNTIME,
+       help="Deterministic fault-injection plan: `site[@N][+][=kind]` "
+            "entries joined by `;` (resilience.FaultPlan.parse), e.g. "
+            "`pass_dispatch@2=oom;probe_spawn@1=timeout`; empty disables."),
+    _K("CYLON_TPU_DEBUG", "bool", False, RUNTIME,
+       help="Enable the span timing log (cylon_tpu.utils.timing)."),
+    _K("CYLON_TEST_NO_COMPILE_CACHE", "bool", False, RUNTIME,
+       help="Disable the per-backend persistent XLA compile cache.  Read "
+            "directly in utils/compile_cache.py (the enabler must work "
+            "before the package is importable); listed here for the "
+            "reference table only."),
+]}
+
+_FALSE_WORDS = ("0", "false", "off", "no")
+
+
+def knob_raw(name: str) -> Optional[str]:
+    """The knob's raw environment value, or None when unset.  ``name`` must
+    be a registered knob — an unregistered read is exactly the drift this
+    registry exists to prevent."""
+    if name not in KNOBS:
+        raise KeyError(f"unregistered knob {name!r}; add it to "
+                       f"cylon_tpu.config.KNOBS")
+    return os.environ.get(name)
+
+
+def knob(name: str):
+    """The knob's parsed value: environment override when set and valid,
+    else the registered default.  Parse failures (bad int/float, enum value
+    outside ``choices``) fall back to the default — matching the historical
+    per-site ``except ValueError`` behavior."""
+    k = KNOBS[name]
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return k.default
+    if k.kind == "str":
+        return raw
+    if k.kind == "enum":
+        return raw if raw in k.choices else k.default
+    if k.kind == "bool":
+        return raw.lower() not in _FALSE_WORDS
+    if k.kind == "int":
+        try:
+            return int(raw)
+        except ValueError:
+            return k.default
+    if k.kind == "float":
+        try:
+            return float(raw)
+        except ValueError:
+            return k.default
+    raise AssertionError(f"unknown knob kind {k.kind!r}")
+
+
+def trace_knobs() -> Tuple[Knob, ...]:
+    """Registry rows with trace scope, in declaration order."""
+    return tuple(k for k in KNOBS.values() if k.scope == TRACE)
+
+
+def trace_cache_token() -> Tuple[Tuple[str, Optional[str]], ...]:
+    """The (name, raw value) vector of every cache-key trace-scope knob.
+
+    Jit-plan caches append this token to their keys so that flipping ANY
+    trace-time knob retraces instead of serving a program traced under the
+    other realization — the generalization of PR 2's hand-keyed
+    ``CYLON_TPU_SHUFFLE_PACK`` fix to the whole registry.  Raw values (not
+    parsed/backend-resolved) suffice: the backend is fixed per process, so
+    "auto" resolves identically for the cache's lifetime."""
+    return tuple((k.name, os.environ.get(k.name))
+                 for k in KNOBS.values() if k.cache_key)
+
+
+@contextlib.contextmanager
+def knob_env(**overrides: Optional[str]):
+    """Temporarily set (or, with None, unset) registered knobs in the
+    process environment — the sanctioned way for harness code (benches,
+    the budget tracer, tests) to flip knobs without reaching into
+    ``os.environ`` and tripping cylint's CY102."""
+    for name in overrides:
+        if name not in KNOBS:
+            raise KeyError(f"unregistered knob {name!r}")
+    saved = {name: os.environ.get(name) for name in overrides}
+    try:
+        for name, val in overrides.items():
+            if val is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = val
+        yield
+    finally:
+        for name, val in saved.items():
+            if val is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = val
+
+
+def knob_table() -> str:
+    """The registry rendered as a markdown table (README's authoritative
+    ``CYLON_TPU_*`` reference; ``python -m cylon_tpu.analysis --knobs``)."""
+    rows = ["| knob | type | default | scope | cache key | purpose |",
+            "|---|---|---|---|---|---|"]
+    for k in KNOBS.values():
+        kind = f"enum{list(k.choices)}" if k.kind == "enum" else k.kind
+        rows.append(f"| `{k.name}` | {kind} | `{k.default!r}` | {k.scope} "
+                    f"| {'yes' if k.cache_key else 'no'} | {k.help} |")
+    return "\n".join(rows)

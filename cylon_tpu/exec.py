@@ -45,6 +45,7 @@ import numpy as np
 
 from . import column as colmod
 from . import resilience
+from . import config
 from .config import JoinConfig, JoinType
 from .ops import groupby as groupby_mod
 from .ops import join as join_mod
@@ -392,8 +393,8 @@ class _SideBuilder:
         # host core.  Costs one sorted copy per column (the box has the
         # RAM; CYLON_TPU_CHUNK_PRESORT=0 reverts to masking).
         pid = np.asarray(pass_ids)
-        self.presort = (os.environ.get("CYLON_TPU_CHUNK_PRESORT", "1")
-                        != "0" and int(pid.max(initial=0)) > 0)
+        self.presort = (config.knob("CYLON_TPU_CHUNK_PRESORT")
+                        and int(pid.max(initial=0)) > 0)
         # single-pass plans skip the grouped copy: the identity argsort +
         # full-column gather would duplicate the whole table for nothing
         if self.presort:
@@ -608,7 +609,7 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
     stats = stats if stats is not None else {}
     max_splits = resilience.max_oom_splits() if plan is not None else 0
     n_parts0 = plan.part_count(0) if plan is not None else None
-    prefetch = prefetch and os.environ.get("CYLON_TPU_PREFETCH", "1") != "0"
+    prefetch = prefetch and config.knob("CYLON_TPU_PREFETCH")
 
     frames: List[Dict[str, np.ndarray]] = []
     total = 0
@@ -1409,7 +1410,7 @@ def chunked_repartition(data, keys, world: int, *, passes: int = 4,
     jax.block_until_ready(prog(*warm))
     del warm
     t_plan = time.perf_counter() - t0
-    prefetch = os.environ.get("CYLON_TPU_PREFETCH", "1") != "0"
+    prefetch = config.knob("CYLON_TPU_PREFETCH")
     t_run0 = time.perf_counter()
     total = 0
     nxt = slice_chunk(0) if prefetch else None

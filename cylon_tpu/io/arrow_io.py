@@ -46,9 +46,9 @@ def _read_csv_arrow(path: PathLike, options: CSVReadOptions):
 def _native_csv_compatible(options: CSVReadOptions) -> bool:
     """The native parser handles the common-case option envelope; anything
     else falls back to the pyarrow reader (same outputs either way)."""
-    import os
+    from .. import config
 
-    if os.environ.get("CYLON_TPU_NO_NATIVE_IO"):
+    if config.knob("CYLON_TPU_NO_NATIVE_IO"):
         return False
     from .. import native
 
@@ -173,9 +173,8 @@ def _shard_path(path: PathLike, shard: int) -> str:
 def _write_csv_columns(cols, total: int, names, path: str,
                        options: CSVWriteOptions) -> None:
     """One local column set -> one CSV file (native writer when possible)."""
-    import os
-
     from .. import column as column_mod
+    from .. import config
     from .. import dtypes, native
 
     # temporal columns need logical formatting (datetime strings, not raw
@@ -185,7 +184,7 @@ def _write_csv_columns(cols, total: int, names, path: str,
                                     dtypes.Type.TIME64)
                    for c in cols)
     if (native.available() and not temporal
-            and not os.environ.get("CYLON_TPU_NO_NATIVE_IO")):
+            and not config.knob("CYLON_TPU_NO_NATIVE_IO")):
         import numpy as np
 
         arrays, validities, lengths_list = [], [], []

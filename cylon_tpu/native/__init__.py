@@ -19,11 +19,12 @@ on this module.
 from __future__ import annotations
 
 import ctypes as ct
-import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from .. import config
 
 # dtype codes shared with src/hashing.cpp / src/csv.cpp
 CT_INT64 = 0
@@ -138,7 +139,7 @@ def _load() -> Optional[ct.CDLL]:
     with _lock:
         if _lib is not None or _load_error is not None:
             return _lib
-        if os.environ.get("CYLON_TPU_NO_NATIVE"):
+        if config.knob("CYLON_TPU_NO_NATIVE"):
             _load_error = "disabled by CYLON_TPU_NO_NATIVE"
             return None
         try:
@@ -282,7 +283,7 @@ class MemoryPool:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # cylint: disable=CY105 -- __del__ runs during interpreter teardown; raising here aborts GC and no Status consumer exists
             pass
 
 

@@ -36,7 +36,10 @@ def build(verbose: bool = False) -> Path:
     lib = lib_path()
     if not needs_build(lib):
         return lib
-    cxx = os.environ.get("CXX", "g++")
+    # NOT config.knob(): setup.py's wheel hook loads this file directly
+    # (spec_from_file_location, no package context — pip's isolated build
+    # env has no jax), so the registry is unreachable here by design
+    cxx = os.environ.get("CXX", "g++")  # cylint: disable=CY102 -- standalone build hook, loaded outside the package where config.py cannot be imported
     # compile to a process-private temp then rename: concurrent importers
     # (multi-rank launches, pytest-xdist) must never dlopen a half-written .so
     tmp = lib.with_name(f"{lib.name}.tmp.{os.getpid()}")

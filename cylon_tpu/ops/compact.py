@@ -19,11 +19,12 @@ Two interchangeable realizations, selected by :func:`permute_mode`:
 """
 from __future__ import annotations
 
-import os
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from .. import config
 
 
 def permute_mode() -> str:
@@ -32,7 +33,7 @@ def permute_mode() -> str:
     CYLON_TPU_PERMUTE overrides; "auto" (default) picks "sort" on
     TPU-family backends (where XLA's sort is bandwidth-bound but its
     scatter serializes) and "scatter" elsewhere.  Read at trace time."""
-    mode = os.environ.get("CYLON_TPU_PERMUTE", "auto")
+    mode = config.knob("CYLON_TPU_PERMUTE")
     if mode in ("scatter", "sort"):
         return mode
     return "sort" if jax.default_backend() in ("tpu", "axon") else "scatter"
@@ -143,8 +144,7 @@ def invperm_mode() -> str:
     and k linear gathers — the crossover is a hardware question
     (microbench + profiler A/B arms; CYLON_TPU_INVPERM overrides).
     Only meaningful when permute_mode() == "sort"."""
-    mode = os.environ.get("CYLON_TPU_INVPERM", "sort")
-    return mode if mode in ("sort", "gather") else "sort"
+    return config.knob("CYLON_TPU_INVPERM")
 
 
 def inverse_permute(perm: jax.Array, *fields: jax.Array) -> Tuple[jax.Array, ...]:

@@ -32,26 +32,23 @@ Env knobs (A/B'd by the TPU battery):
 """
 from __future__ import annotations
 
-import os
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from .. import config
 
 _BLOCK = 256  # matmul-scan block edge: one MXU tile, counts ≤ 256 exact in f32
 
 
 def sort_mode() -> str:
     """Which packed-fast-path sort to use ("cmp" = lax.sort, "radix")."""
-    return os.environ.get("CYLON_TPU_SORT", "cmp")
+    return config.knob("CYLON_TPU_SORT")
 
 
 def radix_bits() -> int:
-    try:
-        d = int(os.environ.get("CYLON_TPU_RADIX_BITS", "1"))
-    except ValueError:
-        d = 1
-    return max(1, min(d, 8))
+    return max(1, min(int(config.knob("CYLON_TPU_RADIX_BITS")), 8))
 
 
 def _cumsum_i32(m: jax.Array) -> jax.Array:
@@ -60,7 +57,7 @@ def _cumsum_i32(m: jax.Array) -> jax.Array:
     Two-level: per-block inclusive scan via one [B,B] upper-triangular f32
     matmul (MXU), plus an exclusive scan of the per-block sums (tiny).
     Falls back to jnp.cumsum under CYLON_TPU_RADIX_SCAN=xla for A/B."""
-    if os.environ.get("CYLON_TPU_RADIX_SCAN") == "xla":
+    if config.knob("CYLON_TPU_RADIX_SCAN") == "xla":
         return jnp.cumsum(m.astype(jnp.int32))
     n = m.shape[0]
     if n < _BLOCK * 4 or n % _BLOCK:

@@ -112,9 +112,9 @@ def _pallas_plain_scan_selected() -> bool:
     (CYLON_TPU_SCAN=pallas / set_scan).  Read at trace time."""
     if _SCAN_MODE is not None:
         return _SCAN_MODE == "pallas"
-    import os
+    from .. import config
 
-    return os.environ.get("CYLON_TPU_SCAN") == "pallas"
+    return config.knob("CYLON_TPU_SCAN") == "pallas"
 
 
 def _span_take(csum0: jax.Array, pos: jax.Array) -> jax.Array:
@@ -181,9 +181,9 @@ def prefix_reductions_enabled() -> bool:
     which clears the jit caches."""
     if _SEGSUM_MODE is not None:
         return _SEGSUM_MODE in ("prefix", "pallas")
-    import os
+    from .. import config
 
-    mode = os.environ.get("CYLON_TPU_SEGSUM")
+    mode = config.knob("CYLON_TPU_SEGSUM")
     if mode in ("prefix", "pallas", "scatter"):
         return mode != "scatter"
     return jax.default_backend() in ("tpu", "axon")
@@ -206,9 +206,9 @@ def _pallas_scan_selected() -> bool:
     the hardware A/B (battery step; keep-or-kill like radix)."""
     if _SEGSUM_MODE is not None:
         return _SEGSUM_MODE == "pallas"
-    import os
+    from .. import config
 
-    return os.environ.get("CYLON_TPU_SEGSUM") == "pallas"
+    return config.knob("CYLON_TPU_SEGSUM") == "pallas"
 
 
 def segmented_reduce_sorted(x: jax.Array, new_group: jax.Array,

@@ -34,11 +34,17 @@ def _shard_map(ctx: CylonContext, fn, key: tuple, shapes_key: tuple,
                out_specs=None):
     from jax.sharding import PartitionSpec as P
 
+    from .. import config
     from ..context import ctx_cache
     from ..utils import shard_map
 
     cache = ctx_cache(ctx, "_plan_cache")
-    cache_key = (key, shapes_key)
+    # every trace-scope knob participates in every plan key: flipping e.g.
+    # CYLON_TPU_PERMUTE or CYLON_TPU_SHUFFLE_PACK must retrace, never serve
+    # a program traced under the other realization (the PR 2 bug class,
+    # generalized; cylint rule CY103 treats builders that append this token
+    # as key-complete)
+    cache_key = (key, shapes_key, config.trace_cache_token())
     entry = cache.get(cache_key)
     if entry is None:
         spec = P(PARTITION_AXIS)
@@ -149,11 +155,10 @@ def _ragged_enabled(ctx) -> bool:
     """Capability check, cached PER CONTEXT: a process that touches a
     CPU-mesh context first (probe -> False) and later a TPU context must
     re-probe on the TPU mesh, not inherit the CPU verdict."""
-    import os
-
+    from .. import config
     from ..context import ctx_cache
 
-    env = os.environ.get("CYLON_TPU_SHUFFLE")
+    env = config.knob("CYLON_TPU_SHUFFLE")
     if env == "bucketed":
         return False
     cache = ctx_cache(ctx, "_ragged_probe")

@@ -38,12 +38,12 @@ in tools/microbench.py, tools/profile_pipeline.py and tools/tpu_battery.sh.
 """
 from __future__ import annotations
 
-import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from .. import config
 from ..column import Column
 
 _UINT_OF = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
@@ -55,7 +55,7 @@ def pack_enabled() -> bool:
     overrides; "auto" (default) packs on TPU-family backends, where
     collective launch count dominates, and stays per-buffer elsewhere.
     Read at trace time — callers key their jit caches on it."""
-    mode = os.environ.get("CYLON_TPU_SHUFFLE_PACK", "auto")
+    mode = config.knob("CYLON_TPU_SHUFFLE_PACK")
     if mode in ("1", "on", "packed"):
         return True
     if mode in ("0", "off", "perbuf"):

@@ -23,10 +23,10 @@ mid-process.
 """
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
+
+from . import config
 
 _MODE: str | None = None  # None = auto-resolve
 
@@ -50,7 +50,7 @@ def on_tpu() -> bool:
 def accumulation_mode() -> str:
     if _MODE is not None:
         return _MODE
-    env = os.environ.get("CYLON_TPU_ACCUM")
+    env = config.knob("CYLON_TPU_ACCUM")
     if env in ("wide", "narrow"):
         return env
     return "narrow" if on_tpu() else "wide"

@@ -38,11 +38,11 @@ e.g. ``CYLON_TPU_FAULT_PLAN="pass_dispatch@2=oom;probe_spawn@1=timeout"``.
 from __future__ import annotations
 
 import contextlib
-import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import config
 from .status import Code, CylonError, Status
 
 # Codes a plain bounded retry may heal.  OutOfMemory is deliberately
@@ -51,25 +51,11 @@ from .status import Code, CylonError, Status
 RETRYABLE_CODES = frozenset({Code.ExecutionError})
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 def max_oom_splits() -> int:
     """How many times the engine may double the pass count before a device
     OOM becomes fatal (``CYLON_TPU_MAX_OOM_SPLITS``, default 4 — a 16x
     refinement of the original plan)."""
-    return max(0, _env_int("CYLON_TPU_MAX_OOM_SPLITS", 4))
+    return max(0, int(config.knob("CYLON_TPU_MAX_OOM_SPLITS")))
 
 
 # ---------------------------------------------------------------------------
@@ -91,9 +77,9 @@ class RetryPolicy:
     @classmethod
     def from_env(cls) -> "RetryPolicy":
         return cls(
-            max_retries=max(0, _env_int("CYLON_TPU_RETRY_MAX", 2)),
-            base_s=max(0.0, _env_float("CYLON_TPU_RETRY_BASE_S", 0.05)),
-            max_s=max(0.0, _env_float("CYLON_TPU_RETRY_MAX_S", 2.0)))
+            max_retries=max(0, int(config.knob("CYLON_TPU_RETRY_MAX"))),
+            base_s=max(0.0, float(config.knob("CYLON_TPU_RETRY_BASE_S"))),
+            max_s=max(0.0, float(config.knob("CYLON_TPU_RETRY_MAX_S"))))
 
     def delay(self, retry_index: int) -> float:
         """Backoff before the ``retry_index``-th retry (0-based)."""
@@ -253,7 +239,7 @@ def active_plan() -> Optional[FaultPlan]:
     global _ENV_PLAN
     if _OVERRIDE_PLAN is not None:
         return _OVERRIDE_PLAN
-    spec = os.environ.get("CYLON_TPU_FAULT_PLAN", "")
+    spec = config.knob_raw("CYLON_TPU_FAULT_PLAN") or ""
     if not spec:
         _ENV_PLAN = None
         return None
@@ -268,7 +254,7 @@ def fault_point(site: str) -> None:
     active — safe on hot paths."""
     plan = _OVERRIDE_PLAN
     if plan is None:
-        if not os.environ.get("CYLON_TPU_FAULT_PLAN"):
+        if not config.knob_raw("CYLON_TPU_FAULT_PLAN"):
             return
         plan = active_plan()
         if plan is None:

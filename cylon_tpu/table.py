@@ -1001,14 +1001,20 @@ def _shard_wise(ctx: CylonContext, fn, *tables: Table, key: tuple):
         return fn(*tables)
     from jax.sharding import PartitionSpec as P
 
+    from . import config
+
     # LRU-bounded: select predicates key entries by object identity, so an
-    # unbounded dict would leak one compiled program per ad-hoc lambda
+    # unbounded dict would leak one compiled program per ad-hoc lambda.
+    # Every trace-scope knob rides the key (trace_cache_token): the local-op
+    # bodies trace accum/segsum/permute modes, and flipping one mid-process
+    # must retrace, never serve the other realization (cylint CY103)
     cache = ctx_cache(ctx, "_shard_fn_cache", maxsize=256)
     cache_key = (key, t0.num_shards,
                  tuple(t.capacity for t in tables),
                  tuple(t.names for t in tables),
                  tuple(tuple((c.dtype, c.data.shape[1:]) for c in t.columns)
-                       for t in tables))
+                       for t in tables),
+                 config.trace_cache_token())
     entry = cache.get(cache_key)
     if entry is None:
         from .utils import shard_map
@@ -1189,13 +1195,12 @@ def _oneshot_oom_fallback(left: Table, right: Optional[Table],
     (real RESOURCE_EXHAUSTED or injected), every involved table is
     single-shard (distributed recovery is the mesh's job), and the knob
     (``CYLON_TPU_ONESHOT_FALLBACK``, default on) allows it."""
-    import os
-
+    from . import config
     from .status import Status
 
     if Status.from_exception(exc).code != Code.OutOfMemory:
         return False
-    if os.environ.get("CYLON_TPU_ONESHOT_FALLBACK", "1") == "0":
+    if not config.knob("CYLON_TPU_ONESHOT_FALLBACK"):
         return False
     if left.num_shards != 1 or (right is not None and right.num_shards != 1):
         return False
@@ -1211,12 +1216,9 @@ def _fallback_passes() -> int:
     """Initial pass count for the one-shot -> chunked fallback
     (``CYLON_TPU_FALLBACK_PASSES``, default 4); the chunked engine's own
     OOM recovery refines further if even that is too coarse."""
-    import os
+    from . import config
 
-    try:
-        return max(2, int(os.environ.get("CYLON_TPU_FALLBACK_PASSES", "4")))
-    except ValueError:
-        return 4
+    return max(2, int(config.knob("CYLON_TPU_FALLBACK_PASSES")))
 
 
 def _table_from_fallback(res: Dict[str, np.ndarray], expected, ctx) -> Table:

@@ -13,15 +13,16 @@ log scraping.
 from __future__ import annotations
 
 import logging
-import os
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from typing import Dict, Iterator, Tuple
 
+from .. import config
+
 log = logging.getLogger("cylon_tpu")
 
-_enabled = bool(os.environ.get("CYLON_TPU_DEBUG"))
+_enabled = bool(config.knob("CYLON_TPU_DEBUG"))
 _totals: Dict[str, float] = defaultdict(float)
 _counts: Dict[str, int] = defaultdict(int)
 

@@ -275,20 +275,10 @@ def test_packed_task_shuffle_agrees(ctx4, monkeypatch, rng):
 _EXCHANGE_PRIMS = ("all_to_all", "ragged_all_to_all")
 _COUNT_PRIMS = ("all_gather",)
 
-
-def _count_prims(jaxpr, names) -> int:
-    """Recursively count primitive applications named in ``names`` across
-    a jaxpr and every sub-jaxpr (pjit/shard_map/scan bodies)."""
-    n = 0
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name in names:
-            n += 1
-        for v in eqn.params.values():
-            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
-                inner = getattr(sub, "jaxpr", sub)
-                if hasattr(inner, "eqns"):
-                    n += _count_prims(inner, names)
-    return n
+# the shared jaxpr meter — single-sourced with the committed collective
+# budgets (cylon_tpu/analysis/budgets/*.json) so this test and the cylint
+# budget gate can never disagree on what counts as a launch
+from cylon_tpu.analysis.budgets import count_prims as _count_prims  # noqa: E402
 
 
 def _traced_shuffle(ctx, cols, targets, world, bucket, out_cap):

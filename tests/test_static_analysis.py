@@ -1,0 +1,416 @@
+"""cylint (cylon_tpu.analysis): seeded known-bad fixtures — one per rule,
+each asserted to fire with the right rule ID and line — plus the
+zero-findings-on-package gate and the collective-budget round trip.
+
+The budget tests double as the tier-1 acceptance meter for PR 2's packed
+exchange: the committed golden pins the packed shuffle at exactly ONE
+data collective (+1 count-matrix all_gather) per exchange, and the gate
+fails when a per-buffer collective is reintroduced.
+"""
+import json
+import os
+import textwrap
+
+import pytest
+
+from cylon_tpu import config
+from cylon_tpu.analysis import astlint, budgets
+
+PKG_DIR = os.path.dirname(os.path.abspath(astlint.__file__))
+PACKAGE = os.path.dirname(PKG_DIR)
+
+
+def _scan(tmp_path, src, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return astlint.scan_paths([str(p)])
+
+
+def _rules_at(findings):
+    return sorted((f.rule, f.line) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# seeded known-bad fixtures, one per rule
+# ---------------------------------------------------------------------------
+
+
+def test_cy101_host_sync_hazards(tmp_path):
+    found = _scan(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def body(x):
+            y = jnp.sum(x)
+            if y:
+                y = y + 1
+            z = float(y)
+            w = np.asarray(y)
+            v = y.item()
+            return y
+        """)
+    assert _rules_at(found) == [("CY101", 8), ("CY101", 10),
+                                ("CY101", 11), ("CY101", 12)]
+    assert "tracer truthiness" in found[0].msg
+    assert "`float()` on a tracer" in found[1].msg
+    assert "np.asarray" in found[2].msg
+    assert ".item()" in found[3].msg
+
+
+def test_cy101_static_predicates_are_legal(tmp_path):
+    # dtype/shape/is-None branches are trace-time constants, not hazards
+    assert _scan(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def body(x, other):
+            y = jnp.cumsum(x)
+            if jnp.issubdtype(y.dtype, jnp.floating):
+                y = y + 1
+            if y.shape[0] > 4:
+                y = y * 2
+            if other is None:
+                return y
+            return y + other
+        """) == []
+
+
+def test_cy101_untraced_function_not_scanned(tmp_path):
+    # same hazards outside any jit/shard_map body: host code, legal
+    assert _scan(tmp_path, """\
+        import jax.numpy as jnp
+
+        def host(x):
+            y = jnp.sum(x)
+            return float(y)
+        """) == []
+
+
+def test_cy102_stray_env_reads(tmp_path):
+    found = _scan(tmp_path, """\
+        import os
+
+        def f():
+            return os.environ.get("CYLON_TPU_WHATEVER")
+
+        def g():
+            return os.getenv("CYLON_TPU_OTHER")
+
+        def h():
+            return os.environ["CYLON_TPU_THIRD"]
+        """)
+    assert _rules_at(found) == [("CY102", 4), ("CY102", 7), ("CY102", 10)]
+    assert "knob registry" in found[0].msg
+
+
+def test_cy102_allows_registry_files():
+    # the two sanctioned readers carry direct os.environ reads by design
+    cfg = os.path.join(PACKAGE, "config.py")
+    cache = os.path.join(PACKAGE, "utils", "compile_cache.py")
+    found = astlint.scan_paths([cfg, cache])
+    assert [f for f in found if f.rule == "CY102"] == []
+
+
+def test_cy103_uncached_trace_knob(tmp_path):
+    found = _scan(tmp_path, """\
+        import jax
+        from cylon_tpu.parallel import plane as plane_mod
+
+        _cache = {}
+
+        def my_builder(ctx, fn, key, shapes_key):
+            entry = _cache.get(key)
+            if entry is None:
+                entry = jax.jit(fn)
+                _cache[key] = entry
+            return entry
+
+        def plan(ctx, t):
+            def body(tt):
+                if plane_mod.pack_enabled():
+                    return tt + 1
+                return tt
+            return my_builder(ctx, body, ("shuffle", 1), ())
+
+        def plan_keyed(ctx, t):
+            def body2(tt):
+                if plane_mod.pack_enabled():
+                    return tt + 1
+                return tt
+            return my_builder(ctx, body2,
+                              ("shuffle", plane_mod.pack_enabled()), ())
+        """)
+    assert _rules_at(found) == [("CY103", 18)]
+    assert "CYLON_TPU_SHUFFLE_PACK" in found[0].msg
+
+
+def test_cy103_keyword_only_key_param(tmp_path):
+    # the table.py::_shard_wise shape: cache key arrives as a keyword-only
+    # param and call sites pass key= — the rule must still see it
+    found = _scan(tmp_path, """\
+        import jax
+        from cylon_tpu.ops import compact as compact_mod
+
+        _cache = {}
+
+        def shard_wise(ctx, fn, *tables, key):
+            entry = _cache.get(key)
+            if entry is None:
+                entry = jax.jit(fn)
+                _cache[key] = entry
+            return entry(*tables)
+
+        def select(ctx, t):
+            def body(tt):
+                if compact_mod.permute_mode() == "sort":
+                    return tt
+                return tt
+            return shard_wise(ctx, body, t, key=("select", 1))
+        """)
+    assert _rules_at(found) == [("CY103", 18)]
+    assert "CYLON_TPU_PERMUTE" in found[0].msg
+
+
+def test_cy103_token_complete_builder_is_exempt(tmp_path):
+    # a builder that appends config.trace_cache_token() covers every knob
+    assert _scan(tmp_path, """\
+        import jax
+        from cylon_tpu import config
+        from cylon_tpu.parallel import plane as plane_mod
+
+        _cache = {}
+
+        def my_builder(ctx, fn, key, shapes_key):
+            cache_key = (key, shapes_key, config.trace_cache_token())
+            entry = _cache.get(cache_key)
+            if entry is None:
+                entry = jax.jit(fn)
+                _cache[cache_key] = entry
+            return entry
+
+        def plan(ctx, t):
+            def body(tt):
+                if plane_mod.pack_enabled():
+                    return tt + 1
+                return tt
+            return my_builder(ctx, body, ("shuffle", 1), ())
+        """) == []
+
+
+def test_cy104_retried_collective(tmp_path):
+    found = _scan(tmp_path, """\
+        import jax
+        from cylon_tpu import resilience
+
+        def exchange():
+            return jax.lax.psum(1, "x")
+
+        def bad(policy):
+            return resilience.retry_call(exchange, policy=policy, site="s")
+
+        def bad_lambda(x, policy):
+            return resilience.retry_call(
+                lambda: jax.lax.all_to_all(x, "x", 0, 0), policy=policy)
+
+        def sanctioned(ctx):
+            return resilience.retry_call(
+                exchange, policy=ctx.collective_retry_policy(), site="s")
+        """)
+    assert _rules_at(found) == [("CY104", 8), ("CY104", 11)]
+    assert "psum" in found[0].msg
+    assert "all_to_all" in found[1].msg
+
+
+def test_cy105_swallowed_exceptions(tmp_path):
+    found = _scan(tmp_path, """\
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+
+        def g():
+            try:
+                return 1
+            except Exception:
+                return 2
+
+        def ok_used():
+            try:
+                return 1
+            except Exception as e:
+                return repr(e)
+
+        def ok_reraise():
+            try:
+                return 1
+            except Exception:
+                raise
+        """)
+    assert _rules_at(found) == [("CY105", 4), ("CY105", 10)]
+    assert "bare" in found[0].msg
+
+
+def test_cy001_suppression_requires_justification(tmp_path):
+    # no justification: the suppression itself is the finding (and does
+    # not silence the underlying rule)
+    found = _scan(tmp_path, """\
+        import os
+
+        def f():
+            return os.getenv("CYLON_TPU_X")  # cylint: disable=CY102
+        """)
+    assert sorted(f.rule for f in found) == ["CY001", "CY102"]
+
+    # with justification: the underlying finding is suppressed
+    found = _scan(tmp_path, """\
+        import os
+
+        def f():
+            return os.getenv("CYLON_TPU_X")  # cylint: disable=CY102 -- fixture exercising the suppression syntax
+        """, name="ok.py")
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# the package itself is clean
+# ---------------------------------------------------------------------------
+
+
+def test_zero_findings_on_package():
+    found = astlint.scan_paths([PACKAGE])
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_cli_main_smoke(tmp_path, capsys):
+    from cylon_tpu.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    assert main(["--knobs"]) == 0
+    out = capsys.readouterr().out
+    assert "CY101" in out and "CYLON_TPU_SHUFFLE_PACK" in out
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nV = os.getenv('CYLON_TPU_Y')\n")
+    assert main([str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# knob registry
+# ---------------------------------------------------------------------------
+
+
+def test_knob_defaults_and_parsing(monkeypatch):
+    for k in config.KNOBS.values():
+        monkeypatch.delenv(k.name, raising=False)
+        assert config.knob(k.name) == k.default, k.name
+    monkeypatch.setenv("CYLON_TPU_PREFETCH", "0")
+    assert config.knob("CYLON_TPU_PREFETCH") is False
+    monkeypatch.setenv("CYLON_TPU_RETRY_MAX", "7")
+    assert config.knob("CYLON_TPU_RETRY_MAX") == 7
+    monkeypatch.setenv("CYLON_TPU_RETRY_MAX", "junk")
+    assert config.knob("CYLON_TPU_RETRY_MAX") == 2  # parse error -> default
+    monkeypatch.setenv("CYLON_TPU_PERMUTE", "bogus")
+    assert config.knob("CYLON_TPU_PERMUTE") == "auto"  # enum guard
+    with pytest.raises(KeyError):
+        config.knob_raw("CYLON_TPU_NOT_A_KNOB")
+
+
+def test_knob_env_roundtrip(monkeypatch):
+    monkeypatch.delenv("CYLON_TPU_SHUFFLE_PACK", raising=False)
+    before = config.trace_cache_token()
+    with config.knob_env(CYLON_TPU_SHUFFLE_PACK="1"):
+        during = config.trace_cache_token()
+        assert ("CYLON_TPU_SHUFFLE_PACK", "1") in during
+    assert config.trace_cache_token() == before
+    with pytest.raises(KeyError):
+        with config.knob_env(CYLON_TPU_NOT_A_KNOB="1"):
+            pass
+
+
+def test_registry_covers_every_trace_accessor():
+    # every trace-scope knob names at least one accessor, and the
+    # accessor's module path exists in the package (guards against the
+    # registry drifting from a refactor)
+    import importlib
+
+    for k in config.KNOBS.values():
+        if k.scope != config.TRACE:
+            continue
+        assert k.cache_key, f"{k.name}: trace-scope implies cache-key"
+        assert k.accessors, f"{k.name}: trace-scope knob without accessors"
+        for acc in k.accessors:
+            mod_name, fn_name = acc.rsplit(".", 1)
+            mod = importlib.import_module(mod_name)
+            assert hasattr(mod, fn_name), f"{acc} does not exist"
+
+
+# ---------------------------------------------------------------------------
+# collective budgets (level 2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced():
+    return budgets.trace_budgets()
+
+
+def test_budget_gate_against_committed_goldens(traced):
+    found = budgets.check_budgets(traced=traced)
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_committed_golden_pins_single_collective():
+    """The acceptance meter: packed exchange = exactly 1 data collective
+    (+1 count all_gather); per-buffer = 13 for the 6-column grid."""
+    golden = budgets.load_golden("shuffle_bucketed")
+    assert golden is not None, "shuffle_bucketed.json not committed"
+    packed = golden["realizations"]["packed"]["collectives"]
+    perbuf = golden["realizations"]["perbuf"]["collectives"]
+    assert packed["all_to_all"] == 1
+    assert packed["all_gather"] == 1
+    assert packed["ragged_all_to_all"] == 0
+    assert perbuf["all_to_all"] == 13
+    task = budgets.load_golden("task_shuffle")["realizations"]
+    assert task["packed"]["collectives"]["all_to_all"] == 1
+    chunk = budgets.load_golden("chunked_pass")["realizations"]["pass"]
+    assert sum(chunk["collectives"].values()) == 0
+
+
+def test_budget_write_read_roundtrip(tmp_path, traced):
+    paths = budgets.write_budgets(str(tmp_path), traced=traced)
+    assert paths and all(os.path.exists(p) for p in paths)
+    assert budgets.check_budgets(str(tmp_path), traced=traced) == []
+
+
+def test_budget_regression_detected(tmp_path, traced):
+    """Reintroducing a per-buffer collective (1 -> 13) must fail."""
+    budgets.write_budgets(str(tmp_path), traced=traced)
+    path = budgets.golden_path("shuffle_bucketed", str(tmp_path))
+    doc = json.load(open(path))
+    doc["realizations"]["packed"]["collectives"]["all_to_all"] = 1
+    json.dump(doc, open(path, "w"))
+    tampered = {k: v for k, v in traced.items()}
+    import copy
+
+    tampered["shuffle_bucketed"] = copy.deepcopy(traced["shuffle_bucketed"])
+    tampered["shuffle_bucketed"]["packed"]["collectives"]["all_to_all"] = 13
+    found = budgets.check_budgets(str(tmp_path), traced=tampered)
+    assert [f.rule for f in found] == ["CY202"]
+    assert "13" in found[0].msg and "shuffle_bucketed/packed" in found[0].msg
+
+
+def test_budget_missing_golden_detected(tmp_path, traced):
+    found = budgets.check_budgets(str(tmp_path), traced=traced)
+    assert found and all(f.rule == "CY201" for f in found)
+
+
+def test_count_prims_shared_with_shuffle_pack():
+    # the refactor satellite: one meter, two consumers
+    from cylon_tpu.analysis.budgets import count_prims
+
+    import tests.test_shuffle_pack as tsp
+
+    assert tsp._count_prims is count_prims

@@ -25,6 +25,15 @@ if [ "$live" != "$PINNED_JAX $PINNED_JAXLIB" ]; then
   echo "         (SIGSEGV originally observed under $CRASH_OBSERVED_UNDER;" >&2
   echo "         re-run this repro and tools/segv_canary.sh, then update the pin)" >&2
 fi
+# static-analysis gate first: trace-safety rules + the jaxpr collective
+# budgets are pure-CPU and catch a 1 -> 13 collective regression in
+# seconds, before the 4-hour tree gets a chance to
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m cylon_tpu.analysis cylon_tpu --budgets || {
+  rc=$?
+  echo "cylint failed (rc=$rc); fix findings before the full tree" >&2
+  exit $rc
+}
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \
