@@ -374,6 +374,30 @@ def _worker(backend: str, skip: int = 0) -> int:
                 # honest partial a tunnel drop cannot erase; superseded
                 # by the completed-sweep fragment that follows
                 frag["partial"] = partial
+        # ISSUE-4: under CYLON_TPU_TRACE=1 the measurement's Perfetto
+        # artifact is exported and its path stamped into the fragment, so
+        # the artifact ledger links every number to its trace.  The
+        # buffers reset after each export (the next fragment's artifact
+        # must describe ONLY its own measurement, and a ladder of sizes
+        # must never fill the event cap with earlier runs' spans), and
+        # the prefix carries a per-fragment sequence so a partial-sweep
+        # fragment and the completed sweep at the same row count never
+        # overwrite each other's artifact.
+        from cylon_tpu.obs import export as _obs_export
+        from cylon_tpu.obs import metrics as _obs_metrics
+        from cylon_tpu.obs import spans as _obs_spans
+
+        if _obs_spans.events_enabled() and partial is None:
+            # completed fragments only: a mid-sweep partial emit runs
+            # INSIDE the timed streaming loop, and exporting + resetting
+            # there would both skew run_seconds and leave the completed
+            # fragment's artifact describing a single pass
+            seq = emit_fragment.trace_seq = getattr(
+                emit_fragment, "trace_seq", -1) + 1
+            tp, _mp = _obs_export.export_all(prefix=f"bench.{rows}.{seq}")
+            frag["trace_artifact"] = tp
+            _obs_spans.reset()
+            _obs_metrics.reset()
         print(json.dumps(frag), flush=True)
 
     sizes = (_tpu_rows() if backend == "tpu" else CPU_ROWS)[skip:]
@@ -536,6 +560,8 @@ class _Bench:
         }
         if r.get("stale_code"):
             out["stale_code"] = True
+        if r.get("trace_artifact"):
+            out["trace_artifact"] = r["trace_artifact"]
         if r.get("passes"):
             out["passes"] = r["passes"]
             if r.get("value_cold") is not None:

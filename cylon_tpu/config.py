@@ -240,7 +240,34 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "entries joined by `;` (resilience.FaultPlan.parse), e.g. "
             "`pass_dispatch@2=oom;probe_spawn@1=timeout`; empty disables."),
     _K("CYLON_TPU_DEBUG", "bool", False, RUNTIME,
-       help="Enable the span timing log (cylon_tpu.utils.timing)."),
+       help="Log every span's duration at INFO (cylon_tpu.obs.spans; the "
+            "utils.timing shim's historical switch)."),
+    _K("CYLON_TPU_TRACE", "enum", "auto", RUNTIME,
+       choices=("1", "on", "auto", "0", "off"),
+       accessors=("cylon_tpu.obs.spans.mode",
+                  "cylon_tpu.obs.spans.enabled",
+                  "cylon_tpu.obs.spans.events_enabled"),
+       help="Observability tracing mode: auto keeps only the always-on "
+            "aggregate stopwatch; 1/on also buffers structured events for "
+            "Perfetto export (obs.export); 0/off disables spans entirely "
+            "(alloc-free no-op).  Spans inside traced bodies consult it "
+            "while tracing but never alter the traced computation, so no "
+            "cache-key participation — the trace-time child spans appear "
+            "on plan BUILDS, not on cached re-runs."),
+    _K("CYLON_TPU_TRACE_SYNC", "bool", False, RUNTIME,
+       accessors=("cylon_tpu.obs.spans.sync_enabled",),
+       help="Fence device work (block_until_ready on a trivial dispatch) "
+            "at span boundaries so device time attributes to the span "
+            "that launched it instead of the span doing the blocking "
+            "fetch.  Off by default: the fence serializes the pipeline."),
+    _K("CYLON_TPU_TRACE_DIR", "str", "traces", RUNTIME,
+       accessors=("cylon_tpu.obs.export.trace_dir",),
+       help="Directory for exported trace/metrics artifacts "
+            "(per-rank file naming: trace.r{rank}.json)."),
+    _K("CYLON_TPU_TRACE_BUFFER_CAP", "int", 65536, RUNTIME,
+       accessors=("cylon_tpu.obs.spans.buffer_cap",),
+       help="Maximum buffered span events per process; past it new events "
+            "are dropped and counted (obs.spans.dropped), never grown."),
     _K("CYLON_TEST_NO_COMPILE_CACHE", "bool", False, RUNTIME,
        help="Disable the per-backend persistent XLA compile cache.  Read "
             "directly in utils/compile_cache.py (the enabler must work "

@@ -46,6 +46,8 @@ import numpy as np
 from . import column as colmod
 from . import resilience
 from . import config
+from .obs import metrics as obs_metrics
+from .obs import spans as obs_spans
 from .config import JoinConfig, JoinType
 from .ops import groupby as groupby_mod
 from .ops import join as join_mod
@@ -674,6 +676,9 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
             # while recovering from memory pressure
             exec_cache.clear()
             stats["oom_splits"] = stats.get("oom_splits", 0) + 1
+            obs_spans.instant("exec.oom_split", level=level,
+                              remaining_parts=len(remaining))
+            obs_metrics.counter_add("oom.refinements")
             return
         if st.code in resilience.RETRYABLE_CODES:
             if part_retries >= policy.max_retries:
@@ -684,6 +689,9 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
             d = policy.delay(part_retries)
             part_retries += 1
             stats["retries"] = stats.get("retries", 0) + 1
+            obs_spans.instant("exec.pass_retry", attempt=part_retries,
+                              code=st.code.name)
+            obs_metrics.counter_add("retry.attempts")
             if d > 0:
                 policy.sleep(d)
             return
@@ -709,18 +717,33 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
         try:
             nxt = chunk(remaining[0]) if prefetch else None
             while cursor < len(remaining):
-                resilience.fault_point("pass_dispatch")
-                cur = nxt if nxt is not None else chunk(remaining[cursor])
-                fut = prog(*cur)                       # async dispatch
-                nxt = (chunk(remaining[cursor + 1])
-                       if prefetch and cursor + 1 < len(remaining) else None)
-                resilience.fault_point("host_fetch")
-                frame, n = fetch(fut)      # blocks; device errors land here
+                with obs_spans.span("exec.pass", part=remaining[cursor],
+                                    level=level) as sp:
+                    resilience.fault_point("pass_dispatch")
+                    cur = nxt if nxt is not None else chunk(remaining[cursor])
+                    fut = prog(*cur)                   # async dispatch
+                    nxt = (chunk(remaining[cursor + 1])
+                           if prefetch and cursor + 1 < len(remaining)
+                           else None)
+                    resilience.fault_point("host_fetch")
+                    frame, n = fetch(fut)  # blocks; device errors land here
+                    if obs_spans.events_enabled():
+                        sp.set(rows=int(n), bytes=int(sum(
+                            a.nbytes for a in frame.values())))
+                        obs_metrics.record_hbm_watermark()
+                    elif cursor == 0 and obs_spans.enabled():
+                        # the watermark gauge is a metrics-side fact, so
+                        # aggregate mode populates it too — but sampling
+                        # scans every live jax array in the process, so
+                        # the always-on default pays it once per level,
+                        # not once per pass
+                        obs_metrics.record_hbm_watermark()
                 total += n
                 frames.append(frame)
                 cursor += 1
                 part_retries = 0
                 stats["parts_run"] = stats.get("parts_run", 0) + 1
+                obs_metrics.counter_add("exec.parts_run")
                 cur = fut = None
                 if progress:
                     _notify_progress(

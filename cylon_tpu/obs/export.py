@@ -1,0 +1,138 @@
+"""Chrome-trace/Perfetto export of the span buffer + flat metrics JSON.
+
+Artifacts load directly in ``ui.perfetto.dev`` / ``chrome://tracing``:
+the trace file is the Chrome Trace Event JSON object form
+(``{"traceEvents": [...]}``) with "X" complete events (``ts``/``dur`` in
+microseconds) and "i" instant events, one ``pid`` per mesh rank and the
+recording thread id as ``tid``.  File names carry the rank
+(``trace.r{rank}.json``) so every process of a multi-host mesh exports
+beside the others without clobbering; the directory comes from the
+``CYLON_TPU_TRACE_DIR`` knob.
+
+``load_trace`` round-trips an export (the schema check
+tests/test_obs.py pins); ``tools/trace_report.py`` builds its top-K
+self-time table on top of these two functions.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, Optional, Tuple
+
+from .. import config
+from . import metrics as metrics_mod
+from . import spans as spans_mod
+
+
+def trace_dir() -> str:
+    """Artifact directory (``CYLON_TPU_TRACE_DIR``, default ``traces``)."""
+    return str(config.knob("CYLON_TPU_TRACE_DIR")) or "traces"
+
+
+def default_rank() -> int:
+    """This process's mesh rank for artifact naming: ``jax.process_index``
+    when jax is up (multi-host meshes), else 0."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        return int(jax.process_index())
+    except Exception as e:  # backend not initialized yet: single-process
+        import logging
+
+        logging.getLogger("cylon_tpu").debug(
+            "process_index unavailable (%s); exporting as rank 0", e)
+        return 0
+
+
+def _artifact_path(path: Optional[str], prefix: str,
+                   rank: Optional[int]) -> str:
+    if path is not None:
+        return path
+    r = default_rank() if rank is None else int(rank)
+    d = trace_dir()
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"{prefix}.r{r}.json")
+
+
+def _event_json(ev: spans_mod.Event, pid: int) -> Dict[str, object]:
+    out: Dict[str, object] = {
+        "name": ev.name, "cat": "cylon_tpu", "ph": ev.ph,
+        "ts": ev.ts / 1e3, "pid": pid, "tid": ev.tid,
+    }
+    if ev.ph == "X":
+        out["dur"] = ev.dur / 1e3
+    else:
+        out["s"] = "t"  # thread-scoped instant
+    args: Dict[str, object] = {"depth": ev.depth}
+    if ev.attrs:
+        args.update(ev.attrs)
+    out["args"] = args
+    return out
+
+
+def export_trace(path: Optional[str] = None, *, rank: Optional[int] = None,
+                 prefix: str = "trace") -> str:
+    """Write the buffered span events as Chrome-trace JSON; returns the
+    file path (``{dir}/{prefix}.r{rank}.json`` unless ``path`` given)."""
+    out_path = _artifact_path(path, prefix, rank)
+    pid = default_rank() if rank is None else int(rank)
+    doc = {
+        "traceEvents": [_event_json(e, pid) for e in spans_mod.events()],
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "cylon_tpu.obs",
+            "rank": pid,
+            "dropped_events": spans_mod.dropped(),
+        },
+    }
+    with open(out_path, "w", encoding="utf-8") as fh:
+        # default=str: attrs may carry dtypes/enums; a label beats a crash
+        json.dump(doc, fh, default=str)
+    return out_path
+
+
+def export_metrics(path: Optional[str] = None, *, rank: Optional[int] = None,
+                   prefix: str = "metrics") -> str:
+    """Write the flat metrics snapshot (+ rank and span-drop counter) as
+    JSON; returns the file path."""
+    out_path = _artifact_path(path, prefix, rank)
+    doc = dict(metrics_mod.snapshot())
+    doc["rank"] = default_rank() if rank is None else int(rank)
+    doc["dropped_events"] = spans_mod.dropped()
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, default=str, sort_keys=True)
+    return out_path
+
+
+def export_all(*, rank: Optional[int] = None,
+               prefix: str = "trace") -> Tuple[str, str]:
+    """Trace + metrics side by side: ``{prefix}.r{rank}.json`` and
+    ``{prefix}.metrics.r{rank}.json``."""
+    return (export_trace(rank=rank, prefix=prefix),
+            export_metrics(rank=rank, prefix=f"{prefix}.metrics"))
+
+
+def load_trace(path: str) -> Dict[str, object]:
+    """Load and validate an exported trace: the object form with a
+    ``traceEvents`` list whose members carry name/ph/ts/pid/tid (and
+    ``dur`` on "X" events)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        raise ValueError(f"{path}: not a Chrome-trace export "
+                         f"(missing traceEvents list)")
+    for ev in evs:
+        for k in ("name", "ph", "ts", "pid", "tid"):
+            if k not in ev:
+                raise ValueError(f"{path}: event missing {k!r}: {ev}")
+        if ev["ph"] == "X" and "dur" not in ev:
+            raise ValueError(f"{path}: complete event missing dur: {ev}")
+    return doc
+
+
+def load_metrics(path: str) -> Dict[str, object]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)

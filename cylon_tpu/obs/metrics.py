@@ -1,0 +1,112 @@
+"""Process-local metrics: counters, gauges and histograms.
+
+The accounting half of the observability subsystem: where ``obs.spans``
+answers "where did the wall-clock go", this module answers "how much
+work actually ran" — collective launches and bytes moved per shuffle
+exchange (``shuffle.collective_launches`` / ``shuffle.bytes_sent``),
+out-of-core refinements (``oom.refinements``), transient retries
+(``retry.attempts``), jit-plan cache traffic (``plan_cache.hit`` /
+``plan_cache.miss``) and the host-visible HBM watermark
+(``hbm.live_bytes`` via ``jax.live_arrays``).
+
+Everything is plain dict arithmetic on the host — no jax dependency, no
+locks on the hot counters (CPython's GIL makes the single add/assign
+effectively atomic, the same contract the PR-0 timing registry relied
+on).  ``snapshot()`` is deterministic: keys come out sorted, so two runs
+recording the same work in any order serialize identically.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional
+
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, float] = {}
+_hists: Dict[str, "_Hist"] = {}
+
+
+class _Hist:
+    """Fixed-shape histogram: count/sum/min/max plus power-of-two bucket
+    counts (bucket i holds values in [2**i, 2**(i+1)); negatives and
+    zeros land in bucket 0)."""
+
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.buckets: Dict[int, int] = {}
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        b = max(0, int(v).bit_length() - 1) if v >= 1 else 0
+        self.buckets[b] = self.buckets.get(b, 0) + 1
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "buckets": {str(k): self.buckets[k]
+                            for k in sorted(self.buckets)}}
+
+
+def counter_add(name: str, value: float = 1) -> None:
+    _counters[name] = _counters.get(name, 0) + value
+
+
+def counter_value(name: str) -> float:
+    return _counters.get(name, 0)
+
+
+def gauge_set(name: str, value: float) -> None:
+    _gauges[name] = float(value)
+
+
+def gauge_max(name: str, value: float) -> None:
+    """Watermark gauge: keeps the maximum ever set."""
+    v = float(value)
+    cur = _gauges.get(name)
+    if cur is None or v > cur:
+        _gauges[name] = v
+
+
+def hist_observe(name: str, value: float) -> None:
+    h = _hists.get(name)
+    if h is None:
+        h = _hists[name] = _Hist()
+    h.observe(value)
+
+
+def record_hbm_watermark() -> int:
+    """Sum live device-array bytes (``jax.live_arrays``) into the
+    ``hbm.live_bytes`` watermark gauge; returns the sampled total.
+    Host-side and jax-optional: 0 when jax was never imported."""
+    jax = sys.modules.get("jax")
+    if jax is None or not hasattr(jax, "live_arrays"):
+        return 0
+    total = 0
+    for a in jax.live_arrays():
+        total += getattr(a, "nbytes", 0) or 0
+    gauge_max("hbm.live_bytes", total)
+    return total
+
+
+def snapshot() -> Dict[str, object]:
+    """Deterministic flat snapshot: {"counters": {...}, "gauges": {...},
+    "histograms": {...}} with every key level sorted."""
+    return {
+        "counters": {k: _counters[k] for k in sorted(_counters)},
+        "gauges": {k: _gauges[k] for k in sorted(_gauges)},
+        "histograms": {k: _hists[k].as_dict() for k in sorted(_hists)},
+    }
+
+
+def reset() -> None:
+    _counters.clear()
+    _gauges.clear()
+    _hists.clear()

@@ -1,0 +1,259 @@
+"""Structured tracing spans: the event substrate behind Perfetto export.
+
+Replaces the PR-0 stopwatch (``utils/timing.py``, now a thin shim over
+this module) with a process-local EVENT BUFFER — every span records
+(monotonic ns start, duration, thread id, nesting depth, attributes) so
+``obs.export`` can emit a Chrome-trace/Perfetto JSON showing exactly
+where wall-clock went, not just per-name totals.
+
+Three operating modes, selected by the ``CYLON_TPU_TRACE`` registry knob
+(read live on every ``span()`` call, so ``config.knob_env`` works):
+
+- ``auto`` (default) — the always-on aggregate stopwatch only: each span
+  costs two ``perf_counter_ns`` reads and two dict updates (the PR-0
+  ``utils.timing`` behavior; benchmarks read phase breakdowns via
+  ``aggregate_report()``).  No event is buffered.
+- ``1`` / ``on`` — aggregates PLUS the bounded event buffer
+  (``CYLON_TPU_TRACE_BUFFER_CAP`` events; past it events are dropped and
+  counted, never grown) for export.
+- ``0`` / ``off`` — a true no-op: ``span()`` returns a process-wide
+  singleton null context manager and touches nothing (the alloc-free
+  fast path tests/test_obs.py pins).
+
+Host-side only, by construction: a span measures host wall-clock between
+``__enter__`` and ``__exit__`` and never reads a device value, so spans
+are legal inside jit/shard_map bodies (they then measure TRACE time and
+appear as children of the enclosing plan-build span — cylint CY101 stays
+green because no tracer is read).  Device execution is asynchronous, so
+by default device time lands in whichever span performed the blocking
+fetch; ``CYLON_TPU_TRACE_SYNC=1`` fences (``block_until_ready`` on a
+trivial dispatch, which on in-order backends drains prior launches) at
+span boundaries to attribute device time to the span that launched it —
+off by default because the fence serializes the pipeline.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
+
+from .. import config
+
+log = logging.getLogger("cylon_tpu")
+
+OFF = "off"
+AGGREGATE = "aggregate"
+EVENTS = "events"
+
+_MODE_OF = {"0": OFF, "off": OFF, "auto": AGGREGATE,
+            "1": EVENTS, "on": EVENTS}
+
+
+class Event(NamedTuple):
+    """One buffered trace event.  ``ts``/``dur`` are monotonic
+    nanoseconds (``time.perf_counter_ns``); ``ph`` is the Chrome-trace
+    phase — "X" complete span, "i" instant."""
+
+    name: str
+    ts: int
+    dur: int
+    tid: int
+    depth: int
+    ph: str
+    attrs: Optional[Dict[str, object]]
+
+
+_events: List[Event] = []
+_dropped = 0
+_totals: Dict[str, float] = {}
+_counts: Dict[str, int] = {}
+_tls = threading.local()
+
+# CYLON_TPU_DEBUG log-on-exit (the PR-0 utils.timing behavior, preserved
+# through the shim): initialized from the knob, flipped by enable_log()
+_log_enabled = bool(config.knob("CYLON_TPU_DEBUG"))
+
+
+def mode() -> str:
+    """The live tracing mode: "off" | "aggregate" | "events"
+    (``CYLON_TPU_TRACE``, read per call so knob_env overrides apply)."""
+    return _MODE_OF.get(str(config.knob("CYLON_TPU_TRACE")), AGGREGATE)
+
+
+def enabled() -> bool:
+    return mode() != OFF
+
+
+def events_enabled() -> bool:
+    return mode() == EVENTS
+
+
+def sync_enabled() -> bool:
+    return bool(config.knob("CYLON_TPU_TRACE_SYNC"))
+
+
+def buffer_cap() -> int:
+    return max(1, int(config.knob("CYLON_TPU_TRACE_BUFFER_CAP")))
+
+
+def enable_log(on: bool = True) -> None:
+    """Flip the per-span INFO log (the old ``utils.timing.enable``)."""
+    global _log_enabled
+    _log_enabled = on
+
+
+def log_enabled() -> bool:
+    return _log_enabled
+
+
+def _depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+def _fence() -> None:
+    """Drain prior device launches: block on a trivial dispatch (in-order
+    execution on TPU/CPU backends means it completes after everything
+    launched before it).  No-op when jax was never imported — obs itself
+    stays importable without jax."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        jax.block_until_ready(jax.numpy.add(jax.numpy.int32(0),
+                                            jax.numpy.int32(0)))
+    except Exception as e:  # a failed fence must never kill the op it wraps
+        log.debug("trace sync fence failed: %s: %s", type(e).__name__, e)
+
+
+def _record(ev: Event) -> None:
+    global _dropped
+    if len(_events) >= buffer_cap():
+        _dropped += 1
+        return
+    _events.append(ev)
+
+
+class _NullSpan:
+    """The disabled-mode singleton: every method is a no-op and ``span()``
+    hands out the same instance, so fully-disabled tracing allocates
+    nothing per call site."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "_t0", "_d", "_buffer", "_sync")
+
+    def __init__(self, name: str, attrs: Optional[Dict[str, object]],
+                 buffer: bool, sync: bool):
+        self.name = name
+        self.attrs = attrs
+        self._buffer = buffer
+        self._sync = sync
+
+    def set(self, **attrs) -> "_Span":
+        """Attach/refresh attributes after entry (e.g. a row count known
+        only once the pass fetched)."""
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "_Span":
+        if self._sync:
+            _fence()
+        self._d = _depth()
+        _tls.depth = self._d + 1
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._sync:
+            _fence()
+        t1 = time.perf_counter_ns()
+        _tls.depth = self._d
+        dur = t1 - self._t0
+        _totals[self.name] = _totals.get(self.name, 0.0) + dur * 1e-9
+        _counts[self.name] = _counts.get(self.name, 0) + 1
+        if self._buffer:
+            _record(Event(self.name, self._t0, dur,
+                          threading.get_ident(), self._d, "X", self.attrs))
+        if _log_enabled:
+            log.info("%s took %.3f ms", self.name, dur * 1e-6)
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager timing one named phase.
+
+    Aggregate totals always accumulate (unless tracing is fully off);
+    under ``CYLON_TPU_TRACE=1`` the span also lands in the event buffer
+    with its attributes.  Use ``as s`` + ``s.set(...)`` for attributes
+    known only at exit."""
+    m = mode()
+    if m == OFF:
+        return _NULL
+    # the sync knob resolves ONCE per span, not per boundary, so
+    # enter/exit stay at two perf_counter reads and two dict updates
+    return _Span(name, attrs or None, m == EVENTS, sync_enabled())
+
+
+def instant(name: str, **attrs) -> None:
+    """Record a zero-duration instant event (retry, injected fault, OOM
+    refinement).  Counted in the aggregates; buffered only under
+    ``CYLON_TPU_TRACE=1``."""
+    m = mode()
+    if m == OFF:
+        return
+    _counts[name] = _counts.get(name, 0) + 1
+    _totals.setdefault(name, 0.0)
+    if m == EVENTS:
+        _record(Event(name, time.perf_counter_ns(), 0,
+                      threading.get_ident(), _depth(), "i", attrs or None))
+
+
+def events() -> Tuple[Event, ...]:
+    """Snapshot of the buffered events, in record order."""
+    return tuple(_events)
+
+
+def dropped() -> int:
+    """Events discarded because the buffer was at capacity."""
+    return _dropped
+
+
+def aggregate_report() -> Dict[str, Tuple[float, int]]:
+    """{span name: (total seconds, call count)} — the PR-0
+    ``utils.timing.report`` surface."""
+    return {k: (_totals[k], _counts.get(k, 0)) for k in _totals}
+
+
+def reset_aggregates() -> None:
+    """Clear the aggregate stopwatch totals ONLY — buffered events and
+    the drop counter survive, so a benchmark clearing phase totals
+    between phases (the historical ``utils.timing.reset``) cannot
+    truncate a pending Perfetto export."""
+    _totals.clear()
+    _counts.clear()
+
+
+def reset() -> None:
+    """Clear the event buffer, the drop counter and the aggregates."""
+    global _dropped
+    _events.clear()
+    _dropped = 0
+    reset_aggregates()

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column
+from ..obs import spans as obs_spans
 from ..ops import compact as compact_mod
 from . import collectives
 from . import plane as plane_mod
@@ -47,6 +48,16 @@ from . import plane as plane_mod
 # derivations; the two predicates must stay identical so count derivation
 # and permutation grouping never desynchronize.
 _WIDE_MESH_CUTOFF = 32
+
+
+def buffer_count(cols: Sequence[Column]) -> int:
+    """Exchanged buffers per row set under the per-buffer realization —
+    data + validity (+ lengths for strings) per column.  The single
+    source behind the per-buffer collective-launch count: the span
+    ``launches`` attrs here and the ``shuffle.collective_launches``
+    metric (parallel/ops.py) must never disagree with the budget
+    goldens on what counts as a launch."""
+    return sum(2 + (1 if c.lengths is not None else 0) for c in cols)
 
 
 def target_counts(targets: jax.Array, world: int) -> jax.Array:
@@ -148,8 +159,13 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
     send_valid = k < jnp.take(counts, t)
     src = jnp.take(perm_t, jnp.clip(src_sorted, 0, cap - 1))
 
-    # count matrix row exchange replaces the length-header protocol
-    cm = collectives.allgather(counts, axis=0).reshape(world, world)
+    # count matrix row exchange replaces the length-header protocol.
+    # The spans here (and below) fire at TRACE time — this body runs on
+    # the host under shard_map tracing — so each plan build nests
+    # counts-gather/pack/collective/unpack children under the enclosing
+    # shuffle.exchange span; no tracer is ever read (cylint CY101).
+    with obs_spans.span("shuffle.counts_gather", world=world):
+        cm = collectives.allgather(counts, axis=0).reshape(world, world)
     me = collectives.my_rank()
     incoming = cm[:, me]
     csum = jnp.cumsum(incoming, dtype=jnp.int32)
@@ -167,25 +183,35 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
         # ONE collective for the whole table: pack at shard capacity,
         # bucket-lay the plane (single gather), exchange, compact (single
         # gather), decode with the tail mask
-        packed = plane_mod.pack_plane(cols)
-        send_plane = jnp.where(send_valid[:, None],
-                               jnp.take(packed, src, axis=0), 0)
-        recv_plane = collectives.all_to_all(send_plane)
-        out_plane = jnp.take(recv_plane, src2, axis=0)
-        return plane_mod.unpack_plane(out_plane, cols,
-                                      valid_mask=valid2), total
+        with obs_spans.span("shuffle.pack", columns=len(cols)) as sp:
+            packed = plane_mod.pack_plane(cols)
+            sp.set(words=int(packed.shape[1]))
+            send_plane = jnp.where(send_valid[:, None],
+                                   jnp.take(packed, src, axis=0), 0)
+        with obs_spans.span("shuffle.collective", family="all_to_all",
+                            packed=True, launches=1):
+            recv_plane = collectives.all_to_all(send_plane)
+        with obs_spans.span("shuffle.unpack", columns=len(cols)):
+            out_plane = jnp.take(recv_plane, src2, axis=0)
+            out = plane_mod.unpack_plane(out_plane, cols, valid_mask=valid2)
+        return out, total
 
     # per-buffer exchange: one tiled all_to_all per buffer
     # (data/validity/lengths) — the whole ArrowAllToAll machinery, but
     # O(buffers x columns) collective launches
-    send_cols = tuple(c.take(src, valid_mask=send_valid) for c in cols)
-    recv_cols = tuple(
-        Column(collectives.all_to_all(c.data),
-               collectives.all_to_all(c.validity),
-               None if c.lengths is None else collectives.all_to_all(c.lengths),
-               c.dtype)
-        for c in send_cols)
-    out_cols = tuple(c.take(src2, valid_mask=valid2) for c in recv_cols)
+    with obs_spans.span("shuffle.pack", columns=len(cols), packed=False):
+        send_cols = tuple(c.take(src, valid_mask=send_valid) for c in cols)
+    with obs_spans.span("shuffle.collective", family="all_to_all",
+                        packed=False, launches=buffer_count(cols)):
+        recv_cols = tuple(
+            Column(collectives.all_to_all(c.data),
+                   collectives.all_to_all(c.validity),
+                   None if c.lengths is None
+                   else collectives.all_to_all(c.lengths),
+                   c.dtype)
+            for c in send_cols)
+    with obs_spans.span("shuffle.unpack", columns=len(cols)):
+        out_cols = tuple(c.take(src2, valid_mask=valid2) for c in recv_cols)
     return out_cols, total
 
 
@@ -248,24 +274,33 @@ def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
     input_offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)[:-1]])
 
-    # on-device count-matrix exchange (the 6-int header protocol's job)
-    cm = collectives.allgather(counts, axis=0).reshape(world, world)
+    # on-device count-matrix exchange (the 6-int header protocol's job);
+    # trace-time child spans, like shuffle_shard's (cylint CY101-clean)
+    with obs_spans.span("shuffle.counts_gather", world=world):
+        cm = collectives.allgather(counts, axis=0).reshape(world, world)
     me = collectives.my_rank()
     recv_sizes, output_offsets, total = ragged_plan(cm, me)
 
     if plane_mod.pack_enabled():
-        packed = plane_mod.pack_plane(cols)
-        sorted_plane = jnp.take(packed, perm_t, axis=0)
-        out = jnp.zeros((out_capacity, packed.shape[1]), packed.dtype)
-        got = collectives.ragged_all_to_all(
-            sorted_plane, out, input_offsets, counts, output_offsets,
-            recv_sizes)
+        with obs_spans.span("shuffle.pack", columns=len(cols)) as sp:
+            packed = plane_mod.pack_plane(cols)
+            sp.set(words=int(packed.shape[1]))
+            sorted_plane = jnp.take(packed, perm_t, axis=0)
+        with obs_spans.span("shuffle.collective",
+                            family="ragged_all_to_all", packed=True,
+                            launches=1):
+            out = jnp.zeros((out_capacity, packed.shape[1]), packed.dtype)
+            got = collectives.ragged_all_to_all(
+                sorted_plane, out, input_offsets, counts, output_offsets,
+                recv_sizes)
         # NO mask on decode: the per-buffer path below moves raw buffers
         # (a null row's bytes pass through untouched), and the plane must
         # stay bit-identical to it; rows past ``total`` decode from the
         # zeros of ``out`` — validity False, zero data — exactly like the
         # unwritten tail of the per-buffer outputs
-        return plane_mod.unpack_plane(got, cols), total
+        with obs_spans.span("shuffle.unpack", columns=len(cols)):
+            out_cols = plane_mod.unpack_plane(got, cols)
+        return out_cols, total
 
     def exchange(buf):
         squeeze = buf.ndim == 1
@@ -283,8 +318,11 @@ def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
             got = got.astype(jnp.bool_)
         return got[:, 0] if squeeze else got
 
-    out_cols = tuple(
-        Column(exchange(c.data), exchange(c.validity),
-               None if c.lengths is None else exchange(c.lengths), c.dtype)
-        for c in cols)
+    with obs_spans.span("shuffle.collective", family="ragged_all_to_all",
+                        packed=False, launches=buffer_count(cols)):
+        out_cols = tuple(
+            Column(exchange(c.data), exchange(c.validity),
+                   None if c.lengths is None else exchange(c.lengths),
+                   c.dtype)
+            for c in cols)
     return out_cols, total

@@ -147,7 +147,13 @@ def _plan_shuffle(t, plan: LogicalTaskPlan):
         return Table(cols, jnp.reshape(total, (1,)), names, ctx)
 
     # trace-time knob -> cache key (same discipline as parallel.ops._shuffled)
-    return par_ops._shard_map(ctx, fn,
-                              ("task_shuffle", lut_key, bucket, out_cap,
-                               plane_mod.pack_enabled()),
-                              par_ops._shapes_key(t))(t)
+    pack = plane_mod.pack_enabled()
+    out = par_ops._shard_map(ctx, fn,
+                             ("task_shuffle", lut_key, bucket, out_cap, pack),
+                             par_ops._shapes_key(t))(t)
+    # the task exchange launches the same collectives as the key shuffle
+    # (budget golden analysis/budgets/task_shuffle.json) — it must show
+    # up in shuffle.collective_launches/bytes_sent like every exchange
+    par_ops._record_exchange(t.columns, pack, "task-bucketed",
+                             world * world * bucket)
+    return out

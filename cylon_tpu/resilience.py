@@ -43,6 +43,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import config
+from .obs import metrics as obs_metrics
+from .obs import spans as obs_spans
 from .status import Code, CylonError, Status
 
 # Codes a plain bounded retry may heal.  OutOfMemory is deliberately
@@ -116,6 +118,11 @@ def retry_call(fn, *, policy: Optional[RetryPolicy] = None, site: str = "op",
                     st.code,
                     f"{site}: retries exhausted after {attempts} attempts: "
                     f"{st.msg}") from e
+            # a retry is an event the trace must show: which site, which
+            # attempt, and how the failure classified
+            obs_spans.instant("retry", site=site, attempt=attempts,
+                              code=st.code.name)
+            obs_metrics.counter_add("retry.attempts")
             if on_retry is not None:
                 on_retry(attempts, st)
             d = policy.delay(retry_index)
@@ -261,6 +268,9 @@ def fault_point(site: str) -> None:
             return
     kind = plan.check(site)
     if kind is not None:
+        obs_spans.instant("fault.injected", site=site, kind=kind,
+                          hit=plan.hits[site])
+        obs_metrics.counter_add("fault.injected")
         raise InjectedFault(site, kind, plan.hits[site])
 
 

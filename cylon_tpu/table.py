@@ -32,6 +32,8 @@ from . import dtypes
 from .column import Column
 from .config import JoinAlgorithm, JoinConfig, JoinType, SortOptions
 from .context import PARTITION_AXIS, CylonContext, ctx_cache, default_context
+from .obs import metrics as obs_metrics
+from .obs import span as obs_span
 from .ops import aggregates as agg_mod
 from .ops import compact as compact_mod
 from .ops import groupby as groupby_mod
@@ -527,7 +529,9 @@ class Table:
                                              nulls_first)
             return Table(cols, t.row_counts, names, ctx)
 
-        return _shard_wise(self.ctx, fn, self, key=("sort", by_idx, asc, nulls_first))
+        with obs_span("table.sort", keys=len(by_idx)):
+            return _shard_wise(self.ctx, fn, self,
+                               key=("sort", by_idx, asc, nulls_first))
 
     # -- join ----------------------------------------------------------
     def join(self, other: "Table", config: Optional[JoinConfig] = None, *,
@@ -544,26 +548,30 @@ class Table:
         from . import resilience
 
         cfg = _join_config(self, other, config, on, left_on, right_on, how, algorithm)
-        try:
-            resilience.fault_point("oneshot_join")
-            return _local_join(self, other, cfg)
-        except Exception as e:
-            if not _oneshot_oom_fallback(self, other, e):
-                raise
-            how_s = {JoinType.INNER: "inner", JoinType.LEFT: "left",
-                     JoinType.RIGHT: "right",
-                     JoinType.FULL_OUTER: "outer"}[cfg.join_type]
-            algo_s = ("hash" if cfg.algorithm == JoinAlgorithm.HASH
-                      else "sort")
-            from . import exec as exec_mod
+        # capacity, not row_count: reading the live count would force a
+        # device sync on every join just to label a span
+        with obs_span("table.join", how=cfg.join_type.name,
+                      algorithm=cfg.algorithm.name, capacity=self.capacity):
+            try:
+                resilience.fault_point("oneshot_join")
+                return _local_join(self, other, cfg)
+            except Exception as e:
+                if not _oneshot_oom_fallback(self, other, e):
+                    raise
+                how_s = {JoinType.INNER: "inner", JoinType.LEFT: "left",
+                         JoinType.RIGHT: "right",
+                         JoinType.FULL_OUTER: "outer"}[cfg.join_type]
+                algo_s = ("hash" if cfg.algorithm == JoinAlgorithm.HASH
+                          else "sort")
+                from . import exec as exec_mod
 
-            res, _stats = exec_mod.chunked_join(
-                self, other, left_on=list(cfg.left_on),
-                right_on=list(cfg.right_on), how=how_s, algo=algo_s,
-                passes=_fallback_passes(), left_prefix=cfg.left_prefix,
-                right_prefix=cfg.right_prefix)
-            expected = _join_output_names(self, other, cfg)
-            return _table_from_fallback(res, expected, self.ctx)
+                res, _stats = exec_mod.chunked_join(
+                    self, other, left_on=list(cfg.left_on),
+                    right_on=list(cfg.right_on), how=how_s, algo=algo_s,
+                    passes=_fallback_passes(), left_prefix=cfg.left_prefix,
+                    right_prefix=cfg.right_prefix)
+                expected = _join_output_names(self, other, cfg)
+                return _table_from_fallback(res, expected, self.ctx)
 
     def distributed_join(self, other: "Table", config: Optional[JoinConfig] = None,
                          *, on=None, left_on=None, right_on=None, how="inner",
@@ -571,13 +579,15 @@ class Table:
         """Global join: shuffle both tables on key columns then join locally
         (reference: DistributedJoin, table.cpp:459-489)."""
         cfg = _join_config(self, other, config, on, left_on, right_on, how, algorithm)
-        if self.num_shards == 1:
-            return _local_join(self, other, cfg)
-        from .parallel import ops as par_ops
+        with obs_span("table.distributed_join", how=cfg.join_type.name,
+                      algorithm=cfg.algorithm.name, world=self.num_shards):
+            if self.num_shards == 1:
+                return _local_join(self, other, cfg)
+            from .parallel import ops as par_ops
 
-        left_sh = par_ops.shuffle(self, cfg.left_on)
-        right_sh = par_ops.shuffle(other, cfg.right_on)
-        return _local_join(left_sh, right_sh, cfg)
+            left_sh = par_ops.shuffle(self, cfg.left_on)
+            right_sh = par_ops.shuffle(other, cfg.right_on)
+            return _local_join(left_sh, right_sh, cfg)
 
     # -- set ops -------------------------------------------------------
     def union(self, other: "Table") -> "Table":
@@ -608,7 +618,9 @@ class Table:
             cols, m = unique_mod.unique(t.columns, t.row_counts[0], key_idx, keep)
             return Table(cols, jnp.reshape(m, (1,)), names, ctx)
 
-        return _shard_wise(self.ctx, fn, self, key=("unique", key_idx, keep))
+        with obs_span("table.unique", keys=len(key_idx)):
+            return _shard_wise(self.ctx, fn, self,
+                               key=("unique", key_idx, keep))
 
     def distributed_unique(self, columns=None, keep: str = "first") -> "Table":
         """reference: DistributedUnique (table.cpp:1031-1047): shuffle on the
@@ -640,11 +652,14 @@ class Table:
             opts = SortOptions(ascending=asc[0], num_bins=opts.num_bins,
                                num_samples=opts.num_samples,
                                nulls_first=opts.nulls_first)
-        if self.num_shards == 1:
-            return self.sort(by, ascending=asc, nulls_first=opts.nulls_first)
-        from .parallel import ops as par_ops
+        with obs_span("table.distributed_sort", keys=len(by_idx),
+                      world=self.num_shards):
+            if self.num_shards == 1:
+                return self.sort(by, ascending=asc,
+                                 nulls_first=opts.nulls_first)
+            from .parallel import ops as par_ops
 
-        return par_ops.distributed_sort(self, by_idx, opts, asc)
+            return par_ops.distributed_sort(self, by_idx, opts, asc)
 
     # -- groupby -------------------------------------------------------
     def groupby(self, by, agg: Dict[ColumnRef, Union[str, Sequence[str]]],
@@ -670,33 +685,36 @@ class Table:
             for op in ops:
                 aggs.append((ci, AggOp.of(op)))
         pipeline = groupby_type == "pipeline"
-        if self.num_shards == 1:
-            from . import resilience
+        with obs_span("table.groupby", kind=groupby_type, keys=len(by_idx),
+                      aggs=len(aggs), world=self.num_shards):
+            if self.num_shards == 1:
+                from . import resilience
 
-            try:
-                resilience.fault_point("oneshot_groupby")
-                return _local_groupby(self, by_idx, tuple(aggs), ddof,
-                                      pipeline)
-            except Exception as e:
-                # the chunked engine is hash-based: substituting it for a
-                # pipeline (run-length) group-by would silently merge
-                # non-adjacent key runs, so pipeline never falls back
-                if pipeline or not _oneshot_oom_fallback(self, None, e):
-                    raise
-                from . import exec as exec_mod
+                try:
+                    resilience.fault_point("oneshot_groupby")
+                    return _local_groupby(self, by_idx, tuple(aggs), ddof,
+                                          pipeline)
+                except Exception as e:
+                    # the chunked engine is hash-based: substituting it for
+                    # a pipeline (run-length) group-by would silently merge
+                    # non-adjacent key runs, so pipeline never falls back
+                    if pipeline or not _oneshot_oom_fallback(self, None, e):
+                        raise
+                    from . import exec as exec_mod
 
-                agg_by_name: Dict[str, list] = {}
-                for ci, op in aggs:
-                    agg_by_name.setdefault(self.names[ci], []).append(op)
-                res, _stats = exec_mod.chunked_groupby(
-                    self, [self.names[i] for i in by_idx], agg_by_name,
-                    ddof=ddof, passes=_fallback_passes())
-                expected = _groupby_output_names(self, by_idx, tuple(aggs))
-                return _table_from_fallback(res, expected, self.ctx)
-        from .parallel import ops as par_ops
+                    agg_by_name: Dict[str, list] = {}
+                    for ci, op in aggs:
+                        agg_by_name.setdefault(self.names[ci], []).append(op)
+                    res, _stats = exec_mod.chunked_groupby(
+                        self, [self.names[i] for i in by_idx], agg_by_name,
+                        ddof=ddof, passes=_fallback_passes())
+                    expected = _groupby_output_names(self, by_idx,
+                                                     tuple(aggs))
+                    return _table_from_fallback(res, expected, self.ctx)
+            from .parallel import ops as par_ops
 
-        return par_ops.distributed_groupby(self, by_idx, tuple(aggs), ddof,
-                                           pipeline)
+            return par_ops.distributed_groupby(self, by_idx, tuple(aggs),
+                                               ddof, pipeline)
 
     # -- scalar aggregates ---------------------------------------------
     def sum(self, ref: ColumnRef):
@@ -953,7 +971,8 @@ class Table:
             return self
         from .parallel import ops as par_ops
 
-        return par_ops.shuffle(self, self._resolve_many(refs))
+        with obs_span("table.shuffle", world=self.num_shards):
+            return par_ops.shuffle(self, self._resolve_many(refs))
 
     def hash_partition(self, refs, num_partitions: int) -> Dict[int, "Table"]:
         """Split into ``num_partitions`` tables by key hash, shard-locally
@@ -1017,12 +1036,15 @@ def _shard_wise(ctx: CylonContext, fn, *tables: Table, key: tuple):
                  config.trace_cache_token())
     entry = cache.get(cache_key)
     if entry is None:
+        obs_metrics.counter_add("plan_cache.miss")
         from .utils import shard_map
 
         spec = P(PARTITION_AXIS)
         entry = jax.jit(shard_map(fn, mesh=ctx.mesh, in_specs=spec,
                                   out_specs=spec, check_vma=False))
         cache[cache_key] = entry
+    else:
+        obs_metrics.counter_add("plan_cache.hit")
     return entry(*tables)
 
 
@@ -1239,8 +1261,6 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
     two-pass (count -> gather) only on the first call or when the cached
     capacity proves too small (the gather's returned row count is checked
     against it before the result is used)."""
-    from .utils import span
-
     names = _join_output_names(left, right, cfg)
     ctx = left.ctx
     jt = cfg.join_type
@@ -1259,7 +1279,7 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
                 cfg.left_on, cfg.right_on, jt, out_cap, algo)
             return Table(cols, jnp.reshape(m, (1,)), names, ctx)
 
-        with span("join.gather"):
+        with obs_span("join.gather"):
             return _shard_wise(ctx, gather_fn, left, right,
                                key=("join", cfg.left_on, cfg.right_on, jt,
                                     out_cap, algo))
@@ -1287,7 +1307,7 @@ def _local_join(left: Table, right: Table, cfg: JoinConfig) -> Table:
     # sizing pass + gather pass, the 2-pass Reserve/build of the reference's
     # join builder (join/join_utils.cpp), with chrono-span parity
     # (join.cpp:89-253 phase timers)
-    with span("join.count"):
+    with obs_span("join.count"):
         counts = _shard_wise(ctx, count_fn, left, right,
                              key=("join_count", cfg.left_on, cfg.right_on, jt,
                                   algo))

@@ -44,6 +44,13 @@ def enable_persistent_compile_cache(min_compile_secs: float = 5,
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_compile_secs)
+        # record the enablement in the observability snapshot so a trace
+        # artifact says whether its compiles could have been cache hits
+        # (imported here, not at module top: this enabler must stay usable
+        # before the package imports)
+        from cylon_tpu.obs import metrics as _obs_metrics
+
+        _obs_metrics.gauge_set("compile_cache.enabled", 1)
         return path
     except Exception as e:
         # visible, not fatal: a silently absent cache costs ~30s/kernel

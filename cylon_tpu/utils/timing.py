@@ -1,61 +1,39 @@
-"""Phase timing spans — the observability the reference gets from manual
-``std::chrono`` + glog pairs around every hot phase (e.g. join combine/
-sort/final-build timers join/join.cpp:89-253, split timing
-partition/partition.cpp:29-57, shuffle left/right timing table.cpp:163-175,
-CYLON_DEBUG-gated phase timers in Unique, table.cpp:970-1026).
+"""Back-compat shim over ``cylon_tpu.obs.spans`` — the one timing
+substrate.
 
-``span("name")`` measures wall time; enabled when the ``CYLON_TPU_DEBUG``
-env var is set (the reference's CYLON_DEBUG build flag) or via
-``enable()``.  Spans always accumulate into a process-local registry that
-``report()`` snapshots, so benchmarks can read phase breakdowns without
-log scraping.
+PR 0 grew this module as a standalone stopwatch registry (the
+reference's manual ``std::chrono`` + glog pairs, e.g. join timers
+join/join.cpp:89-253, split timing partition/partition.cpp:29-57); PR 4
+replaced the duplicated stopwatch logic with the structured tracing
+subsystem.  ``span`` IS ``obs.spans.span`` (aggregate totals always
+accumulate; ``CYLON_TPU_TRACE=1`` additionally buffers events for
+Perfetto export), and ``report()``/``reset()`` read/clear the same
+aggregate registry benchmarks always consumed.  New code should import
+from ``cylon_tpu.obs`` directly.
 """
 from __future__ import annotations
 
-import logging
-import time
-from collections import defaultdict
-from contextlib import contextmanager
-from typing import Dict, Iterator, Tuple
+from typing import Dict, Tuple
 
-from .. import config
-
-log = logging.getLogger("cylon_tpu")
-
-_enabled = bool(config.knob("CYLON_TPU_DEBUG"))
-_totals: Dict[str, float] = defaultdict(float)
-_counts: Dict[str, int] = defaultdict(int)
+from ..obs import spans as _spans
+from ..obs.spans import span  # noqa: F401  (the shimmed entry point)
 
 
 def enable(on: bool = True) -> None:
-    global _enabled
-    _enabled = on
+    """Flip the per-span INFO log (historically CYLON_TPU_DEBUG)."""
+    _spans.enable_log(on)
 
 
 def enabled() -> bool:
-    return _enabled
-
-
-@contextmanager
-def span(name: str) -> Iterator[None]:
-    """Wall-time span; logs at INFO when debug timing is on and always
-    accumulates into the registry."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        _totals[name] += dt
-        _counts[name] += 1
-        if _enabled:
-            log.info("%s took %.3f ms", name, dt * 1e3)
+    return _spans.log_enabled()
 
 
 def report() -> Dict[str, Tuple[float, int]]:
     """{span name: (total seconds, call count)} snapshot."""
-    return {k: (_totals[k], _counts[k]) for k in _totals}
+    return _spans.aggregate_report()
 
 
 def reset() -> None:
-    _totals.clear()
-    _counts.clear()
+    """Clear the aggregate registry only — buffered trace events pending
+    export are NOT discarded (use obs.spans.reset for everything)."""
+    _spans.reset_aggregates()

@@ -133,3 +133,55 @@ def test_chunked_negative_int64_keys(rng):
     lv = rng.random(n).astype(np.float32)
     rv = rng.random(n).astype(np.float32)
     _check(lk, lv, rk, rv, 6, rtol=1e-4)
+
+
+@pytest.mark.fault
+def test_faulted_run_emits_obs_events(rng):
+    """ISSUE-4: the engine's per-pass stats now ride cylon_tpu.obs — an
+    env-driven (CYLON_TPU_FAULT_PLAN) injected OOM mid-stream must leave
+    refinement/fault instants in the event stream, per-pass spans with
+    rows/level attrs, and matching oom.refinements/exec.parts_run
+    counters in the metrics snapshot."""
+    from cylon_tpu import config
+    from cylon_tpu.obs import metrics as obs_metrics
+    from cylon_tpu.obs import spans as obs_spans
+
+    n = 20_000
+    lk = rng.integers(0, n, n).astype(np.int32)
+    lv = rng.random(n).astype(np.float32)
+    rk = rng.integers(0, n, n).astype(np.int32)
+    rv = rng.random(n).astype(np.float32)
+    obs_spans.reset()
+    obs_metrics.reset()
+    try:
+        with config.knob_env(CYLON_TPU_FAULT_PLAN="pass_dispatch@2=oom",
+                             CYLON_TPU_TRACE="1"):
+            res, stats = chunked_join_groupby(lk, lv, rk, rv, 4)
+        assert stats["oom_splits"] == 1
+        evs = obs_spans.events()
+        by_name = {}
+        for e in evs:
+            by_name.setdefault(e.name, []).append(e)
+        # the injected fault and the refinement it caused are instants
+        assert [e.attrs["site"] for e in by_name["fault.injected"]] \
+            == ["pass_dispatch"]
+        assert by_name["fault.injected"][0].attrs["kind"] == "oom"
+        splits = by_name["exec.oom_split"]
+        assert len(splits) == 1 and splits[0].attrs["level"] == 1
+        # per-pass spans carry rows + refinement depth; parts at level 1
+        # re-ran after the split (1 completed at level 0 + 3*2 children),
+        # and the FAILED attempt is a span too — closed by the exception,
+        # with no rows attr because it never fetched
+        passes = by_name["exec.pass"]
+        done = [e for e in passes if "rows" in (e.attrs or {})]
+        assert len(done) == stats["parts_run"] == 7
+        assert len(passes) == 8
+        assert {e.attrs["level"] for e in passes} == {0, 1}
+        assert all(e.attrs["rows"] >= 0 for e in done)
+        counters = obs_metrics.snapshot()["counters"]
+        assert counters["oom.refinements"] == 1
+        assert counters["fault.injected"] == 1
+        assert counters["exec.parts_run"] == 7
+    finally:
+        obs_spans.reset()
+        obs_metrics.reset()

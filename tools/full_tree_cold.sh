@@ -34,6 +34,39 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   echo "cylint failed (rc=$rc); fix findings before the full tree" >&2
   exit $rc
 }
+# trace smoke (ISSUE-4): one small world-4 distributed join with event
+# tracing on must export a Perfetto/Chrome-trace artifact that loads and
+# carries the exchange spans — catches an obs wiring regression in
+# seconds, before the full tree runs
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    CYLON_TPU_TRACE=1 CYLON_TPU_TRACE_DIR=/tmp/cylon_trace_smoke \
+    python - <<'PYEOF'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cylon_tpu import Table
+from cylon_tpu.context import CylonContext, TPUConfig
+from cylon_tpu.obs import export, metrics, spans
+ctx = CylonContext.InitDistributed(TPUConfig(world_size=4))
+n = 128
+t = Table.from_numpy(["k", "v"], [np.arange(n, dtype=np.int32) % 17,
+                                  np.arange(n, dtype=np.float32)],
+                     ctx=ctx, capacity=n)
+j = t.distributed_join(t, on="k")
+assert j.row_count > 0
+tp, mp = export.export_all(prefix="smoke")
+doc = export.load_trace(tp)
+names = {e["name"] for e in doc["traceEvents"]}
+assert "shuffle.exchange" in names and "table.distributed_join" in names, names
+assert metrics.snapshot()["counters"]["shuffle.collective_launches"] > 0
+print(f"trace smoke ok: {tp} ({len(doc['traceEvents'])} events)")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "trace smoke failed (rc=$rc); fix obs wiring before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

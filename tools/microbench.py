@@ -27,6 +27,9 @@ from cylon_tpu.utils.compile_cache import enable_persistent_compile_cache  # noq
 
 enable_persistent_compile_cache()
 
+from cylon_tpu.obs import export as obs_export  # noqa: E402
+from cylon_tpu.obs import spans as obs_spans  # noqa: E402
+
 N = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 26)
 REPS = 3
 
@@ -46,16 +49,18 @@ seg = jnp.asarray(np.sort(rng.integers(0, N // 8 or 1, N)).astype(np.int32))
 def timed(name, fn, *args, traffic_bytes=None):
     f = jax.jit(fn)
     try:
-        out = f(*args)
-        leaf = jax.tree_util.tree_leaves(out)[0]
-        np.asarray(jax.device_get(leaf[:1]))  # force completion
-        ts = []
-        for _ in range(REPS):
-            t0 = time.perf_counter()
+        with obs_spans.span("microbench.warm", op=name):
             out = f(*args)
             leaf = jax.tree_util.tree_leaves(out)[0]
-            np.asarray(jax.device_get(leaf[:1]))
-            ts.append(time.perf_counter() - t0)
+            np.asarray(jax.device_get(leaf[:1]))  # force completion
+        ts = []
+        for _ in range(REPS):
+            with obs_spans.span("microbench.rep", op=name):
+                t0 = time.perf_counter()
+                out = f(*args)
+                leaf = jax.tree_util.tree_leaves(out)[0]
+                np.asarray(jax.device_get(leaf[:1]))
+                ts.append(time.perf_counter() - t0)
         ms = min(ts) * 1e3
         gbs = ""
         if traffic_bytes:
@@ -180,4 +185,11 @@ timed("plane gather + unpack (packed)",
 timed("per-buffer gathers (12 buffers)",
       lambda cs, i, m: tuple(col.take(i, valid_mask=m) for col in cs),
       cols6, perm, live, traffic_bytes=(2 * ROW_B + 4 * len(cols6)) * N)
+# ISSUE-4: emit the trace artifact beside the numbers when event tracing
+# is on (CYLON_TPU_TRACE=1) so a regression hunt can open the Perfetto
+# view of the exact run that produced the table above
+if obs_spans.events_enabled():
+    _tp, _mp = obs_export.export_all(prefix="microbench")
+    print(f"trace artifact: {_tp}", flush=True)
+    print(f"metrics artifact: {_mp}", flush=True)
 print("done", flush=True)

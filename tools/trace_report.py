@@ -1,0 +1,160 @@
+"""Summarize a cylon_tpu.obs trace export: top-K self-time + collectives.
+
+Loads a Chrome-trace JSON written by ``cylon_tpu.obs.export`` and prints
+
+- a top-K table by SELF time (a span's duration minus its children's, so
+  a fat parent that merely wraps a fat child doesn't dominate the table),
+- the instant-event tally (retries, injected faults, OOM refinements),
+- when the sibling metrics artifact exists (``<name>.metrics.rN.json``
+  next to the trace, or passed explicitly), the collective/bytes summary
+  — launches, exchanges, bytes sent, plan-cache traffic.
+
+Usage:
+    python tools/trace_report.py TRACE.json [METRICS.json] [--top K]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+def load_trace(path: str) -> Dict[str, object]:
+    """Load and validate a Chrome-trace export (the same schema contract
+    as ``cylon_tpu.obs.export.load_trace``, duplicated here so the
+    reporter stays a pure-JSON tool — no jax, no package import)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        raise ValueError(f"{path}: not a Chrome-trace export "
+                         f"(missing traceEvents list)")
+    for ev in evs:
+        for k in ("name", "ph", "ts", "pid", "tid"):
+            if k not in ev:
+                raise ValueError(f"{path}: event missing {k!r}: {ev}")
+        if ev["ph"] == "X" and "dur" not in ev:
+            raise ValueError(f"{path}: complete event missing dur: {ev}")
+    return doc
+
+
+def load_metrics(path: str) -> Dict[str, object]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def self_times(events: List[dict]) -> Dict[str, Tuple[int, float, float]]:
+    """{name: (count, total_us, self_us)} over the "X" events.
+
+    Self time subtracts each span's direct children, found by interval
+    containment per (pid, tid) with a stack sweep over start-ordered
+    events — the standard flame-graph attribution."""
+    total: Dict[str, float] = defaultdict(float)
+    self_t: Dict[str, float] = defaultdict(float)
+    count: Dict[str, int] = defaultdict(int)
+    by_track: Dict[tuple, List[list]] = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "X":
+            # local [name, ts, dur, child_acc] records — never mutate the
+            # caller's dicts, so repeat calls on one loaded trace agree
+            by_track[(e.get("pid"), e.get("tid"))].append(
+                [e["name"], e["ts"], e["dur"], 0.0])
+    for track in by_track.values():
+        track.sort(key=lambda r: (r[1], -r[2]))
+        stack: List[list] = []  # enclosing spans, child time accumulating
+        for rec in track:
+            name, ts, dur, _ = rec
+            while stack and ts >= stack[-1][1] + stack[-1][2]:
+                done = stack.pop()
+                self_t[done[0]] += done[2] - done[3]
+            if stack:
+                stack[-1][3] += dur
+            total[name] += dur
+            count[name] += 1
+            stack.append(rec)
+        while stack:
+            done = stack.pop()
+            self_t[done[0]] += done[2] - done[3]
+    return {n: (count[n], total[n], self_t[n]) for n in total}
+
+
+def print_report(trace_path: str, metrics_path: "str | None",
+                 top: int) -> None:
+    doc = load_trace(trace_path)
+    events = doc["traceEvents"]
+    other = doc.get("otherData", {})
+    st = self_times(events)
+    grand_self = sum(s for _, _, s in st.values()) or 1.0
+    print(f"trace: {trace_path}  rank={other.get('rank', '?')}  "
+          f"events={len(events)}  dropped={other.get('dropped_events', 0)}")
+    print(f"\ntop {top} by self time:")
+    print(f"{'span':34s} {'count':>7s} {'total ms':>10s} {'self ms':>10s} "
+          f"{'self %':>7s}")
+    ranked = sorted(st.items(), key=lambda kv: -kv[1][2])[:top]
+    for name, (n, tot, self_us) in ranked:
+        print(f"{name:34s} {n:7d} {tot / 1e3:10.3f} {self_us / 1e3:10.3f} "
+              f"{100 * self_us / grand_self:6.1f}%")
+
+    instants: Dict[str, int] = defaultdict(int)
+    for e in events:
+        if e.get("ph") == "i":
+            instants[e["name"]] += 1
+    if instants:
+        print("\ninstant events:")
+        for name in sorted(instants):
+            print(f"  {name:32s} {instants[name]:7d}")
+
+    if metrics_path is None:
+        import re
+
+        d, base = os.path.split(trace_path)
+        cands = [
+            # export_all naming: prefix.rN.json -> prefix.metrics.rN.json
+            os.path.join(d, re.sub(r"\.r(\d+)\.json$", r".metrics.r\1.json",
+                                   base)),
+            # plain export naming: trace.rN.json -> metrics.rN.json
+            os.path.join(d, base.replace("trace", "metrics", 1)),
+        ]
+        for cand in cands:
+            if cand != trace_path and os.path.exists(cand):
+                metrics_path = cand
+                break
+    if metrics_path and os.path.exists(metrics_path):
+        m = load_metrics(metrics_path)
+        c = m.get("counters", {})
+        print(f"\nmetrics: {metrics_path}")
+        print(f"  shuffle exchanges          {c.get('shuffle.exchanges', 0):>12}")
+        print(f"  collective launches        "
+              f"{c.get('shuffle.collective_launches', 0):>12}")
+        print(f"  counts gathers             "
+              f"{c.get('shuffle.counts_gathers', 0):>12}")
+        print(f"  bytes sent                 "
+              f"{c.get('shuffle.bytes_sent', 0):>12}")
+        print(f"  plan cache hit/miss        "
+              f"{c.get('plan_cache.hit', 0)}/{c.get('plan_cache.miss', 0)}")
+        print(f"  retries / oom refinements  "
+              f"{c.get('retry.attempts', 0)}/{c.get('oom.refinements', 0)}")
+        g = m.get("gauges", {})
+        if "hbm.live_bytes" in g:
+            print(f"  hbm watermark bytes        "
+                  f"{int(g['hbm.live_bytes']):>12}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_report",
+        description="top-K self-time + collective/bytes summary of a "
+                    "cylon_tpu.obs trace export")
+    ap.add_argument("trace", help="trace JSON written by obs.export")
+    ap.add_argument("metrics", nargs="?", default=None,
+                    help="metrics JSON (default: sibling of the trace)")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args(argv)
+    print_report(args.trace, args.metrics, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
