@@ -239,6 +239,28 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
        help="Deterministic fault-injection plan: `site[@N][+][=kind]` "
             "entries joined by `;` (resilience.FaultPlan.parse), e.g. "
             "`pass_dispatch@2=oom;probe_spawn@1=timeout`; empty disables."),
+    _K("CYLON_TPU_DURABLE_DIR", "str", "", RUNTIME,
+       accessors=("cylon_tpu.durable.durable_dir",
+                  "cylon_tpu.durable.enabled"),
+       help="Root directory for the durable-execution run journal: each "
+            "fingerprinted chunked run spills completed passes as "
+            "checksummed Arrow IPC files + an append-only manifest, so a "
+            "fresh process re-invoking the same run resumes mid-plan "
+            "(kill -9 safe).  Empty (default) disables journaling."),
+    _K("CYLON_TPU_PASS_DEADLINE_S", "float", 0.0, RUNTIME,
+       accessors=("cylon_tpu.durable.deadline_s",
+                  "cylon_tpu.durable.pass_deadline"),
+       help="Per-pass wall-clock budget: a watchdog thread fires "
+            "deadline.fired when a pass runs past it and the pass is "
+            "classified Code.Timeout (retried like a transient).  "
+            "0 (default) disables."),
+    _K("CYLON_TPU_QUARANTINE_AFTER", "int", 0, RUNTIME,
+       accessors=("cylon_tpu.durable.quarantine_after",),
+       help="Poison-pass quarantine: a part failing with the same "
+            "classified code this many consecutive times is isolated "
+            "into the run report (stats['quarantined']) instead of "
+            "wedging retries/refinement forever.  0 (default) disables "
+            "(PR-1 fail-fast behavior)."),
     _K("CYLON_TPU_DEBUG", "bool", False, RUNTIME,
        help="Log every span's duration at INFO (cylon_tpu.obs.spans; the "
             "utils.timing shim's historical switch)."),

@@ -1,0 +1,541 @@
+"""Durable execution: journaled spill-to-disk checkpoints, cross-process
+crash-resume, pass deadlines, and poison-pass quarantine.
+
+PR 1 made device OOM a *recoverable* condition, but recovery only
+survived inside one living process: a killed worker, a preempted TPU VM,
+or a wedged collective still lost the whole out-of-core run.  The
+reference survives failures by restarting the MPI job from source data;
+the production-scale analog (ROADMAP north star) is elastic recovery —
+the same spill/re-materialize-per-part shape as "Memory-efficient array
+redistribution through portable collective communication" and the
+bounded-retry/deadline discipline of "Scalable Distributed DNN Training
+using TensorFlow and CUDA-Aware MPI" (PAPERS.md).  Three primitives:
+
+- **run journal** (`RunJournal`) — every chunked run is fingerprinted
+  (op spec x sampled input content x world/knob config,
+  :func:`run_fingerprint`); each completed pass's host frame spills to
+  an Arrow IPC file (``io.arrow_io.frame_to_ipc_bytes``) with a sha256
+  checksum and an ATOMIC rename under ``CYLON_TPU_DURABLE_DIR``, and
+  pass completion lands in an append-only ``MANIFEST.jsonl`` (fsync'd
+  per line).  A fresh process re-invoking the same run loads completed
+  parts from the spills and resumes mid-plan — a ``kill -9`` costs at
+  most the in-flight pass.  A truncated/corrupt spill fails its
+  checksum and is silently re-executed; a manifest whose recorded
+  fingerprint disagrees with the run's is refused outright (stale
+  spills never leak into a different run's output).
+
+- **pass deadlines** (:func:`pass_deadline`) — a watchdog thread armed
+  per pass fires ``deadline.fired`` (obs instant + metric) the moment
+  ``CYLON_TPU_PASS_DEADLINE_S`` elapses, and the pass is classified
+  `Code.Timeout` through the existing `Status` taxonomy when control
+  returns, which the streaming loop retries like any transient.  The
+  watchdog cannot preempt a wedged native call (nothing host-side can);
+  it guarantees the hang is *visible* in the trace in real time and
+  *classified* — never mistaken for a bug or an OOM.
+
+- **poison-pass quarantine** — a part that fails the same way
+  ``CYLON_TPU_QUARANTINE_AFTER`` consecutive times is isolated into the
+  run report (``stats["quarantined"]`` + a manifest record) instead of
+  wedging refinement forever; 0 (default) preserves the PR-1 fail-fast
+  behavior.  Only classified-recoverable codes (OOM / transient /
+  timeout) are quarantinable — a TypeError stays the bug it is.
+
+Everything here is host-side (no jax import, no traced code), so the
+jaxpr collective-budget goldens are untouched by construction and all
+of it is deterministic-testable on CPU: the ``killhard`` fault kind
+(``os._exit`` mid-journal) and ``journal_corrupt`` (truncates the last
+committed spill) drive subprocess crash-resume tests, ``hang`` sleeps a
+pass past its deadline (tests/test_durable.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import config
+from .obs import metrics as obs_metrics
+from .obs import spans as obs_spans
+from .status import Code, CylonError
+
+log = logging.getLogger("cylon_tpu")
+
+MANIFEST = "MANIFEST.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def durable_dir() -> str:
+    """Journal root (``CYLON_TPU_DURABLE_DIR``); empty disables."""
+    return str(config.knob("CYLON_TPU_DURABLE_DIR"))
+
+
+def enabled() -> bool:
+    return bool(durable_dir())
+
+
+def deadline_s() -> float:
+    """Per-pass wall-clock budget (``CYLON_TPU_PASS_DEADLINE_S``);
+    0 (default) disables the watchdog."""
+    return max(0.0, float(config.knob("CYLON_TPU_PASS_DEADLINE_S")))
+
+
+def quarantine_after() -> int:
+    """Consecutive same-code failures before a part is quarantined
+    (``CYLON_TPU_QUARANTINE_AFTER``); 0 (default) disables."""
+    return max(0, int(config.knob("CYLON_TPU_QUARANTINE_AFTER")))
+
+
+# ---------------------------------------------------------------------------
+# run fingerprinting
+# ---------------------------------------------------------------------------
+
+_OBJ_SLAB = 1 << 20   # object-column elements decoded per hashing slab
+_MIX_SLAB = 1 << 22   # u64 words mixed per vectorized slab (32 MB)
+
+
+def _mix_u64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer (uint64 wraparound arithmetic) — local twin
+    of exec._mix_u64 (importing exec here would be a cycle)."""
+    x = np.asarray(x, np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _update_spec(h, obj) -> None:
+    """Feed a canonical encoding of a primitive/tuple spec into ``h`` —
+    type-tagged so ("1",) and (1,) hash apart."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        h.update(f"<{type(obj).__name__}:{obj!r}>".encode())
+        return
+    if isinstance(obj, (tuple, list)):
+        h.update(b"<seq[")
+        for item in obj:
+            _update_spec(h, item)
+        h.update(b"]>")
+        return
+    raise CylonError(Code.Invalid,
+                     f"unhashable fingerprint spec element {type(obj)}")
+
+
+def _update_array(h, name: str, a: np.ndarray) -> None:
+    """Fold one input column into the fingerprint with FULL content
+    coverage — changing ANY element (at any index) changes the
+    fingerprint, so a stale journal can never silently serve a modified
+    run.  Fixed-width columns reduce through a position-mixed splitmix64
+    xor-fold at memory bandwidth in bounded slabs (no big transients);
+    object columns hash their decoded codepoints slab-wise (str()
+    coercion is deterministic for the payloads frames carry: np scalars
+    / str / bytes / None)."""
+    a = np.asarray(a)
+    h.update(f"|col:{name}:{a.dtype.str}:{a.shape}".encode())
+    if a.size == 0:
+        return
+    flat = a.reshape(-1)
+    if a.dtype.kind == "O":
+        for lo in range(0, flat.size, _OBJ_SLAB):
+            sl = flat[lo:lo + _OBJ_SLAB]
+            # per-element kind tags disambiguate what str() coercion
+            # conflates: None vs the literal string "None", and bytes
+            # vs a str that happens to equal their repr
+            tags = np.fromiter(
+                (0 if x is None
+                 else 1 if isinstance(x, (str, np.str_))
+                 else 2 if isinstance(x, (bytes, np.bytes_))
+                 else 3 for x in sl), np.uint8, count=len(sl))
+            h.update(tags.tobytes())
+            h.update(np.asarray(sl.astype("U")).tobytes())
+        return
+    b = np.ascontiguousarray(flat).view(np.uint8).reshape(-1)
+    n_words = -(-b.size // 8)
+    acc = np.uint64(0)
+    for lo in range(0, n_words, _MIX_SLAB):
+        hi = min(lo + _MIX_SLAB, n_words)
+        chunk = b[lo * 8:min(hi * 8, b.size)]
+        if len(chunk) < (hi - lo) * 8:  # zero-pad the final partial word
+            chunk = np.concatenate(
+                [chunk, np.zeros((hi - lo) * 8 - len(chunk), np.uint8)])
+        words = np.ascontiguousarray(chunk).view(np.uint64)
+        pos = np.arange(lo, hi, dtype=np.uint64)
+        acc = acc ^ np.uint64(np.bitwise_xor.reduce(
+            _mix_u64(words ^ _mix_u64(pos))))
+    h.update(int(acc).to_bytes(8, "little"))
+
+
+def run_fingerprint(op: str, spec, frames: Sequence[Tuple[Sequence[str],
+                                                          Dict]]) -> str:
+    """Hex fingerprint of one chunked run: op kind x plan/op spec x every
+    input column's (sampled) content x the trace-knob configuration that
+    can change results.  Two invocations share a journal exactly when
+    this agrees."""
+    h = hashlib.sha256()
+    h.update(f"cylon_tpu.durable.v1|{op}".encode())
+    _update_spec(h, spec)
+    # trace-scope knobs change the traced computation, hence the results
+    # a resumed run must match; raw values, like the jit-plan cache keys
+    _update_spec(h, [list(kv) for kv in config.trace_cache_token()])
+    for names, arrs in frames:
+        h.update(b"|frame")
+        for name in names:
+            _update_array(h, str(name), np.asarray(arrs[name]))
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the run journal
+# ---------------------------------------------------------------------------
+
+# most recently opened journal — the handle the `journal_corrupt` fault
+# kind corrupts (deterministic crash-resume tests, resilience.fault_point)
+_LAST_JOURNAL: Optional["RunJournal"] = None
+
+
+class RunJournal:
+    """Append-only manifest + checksummed Arrow IPC spills for one
+    fingerprinted run under ``<CYLON_TPU_DURABLE_DIR>/<fingerprint>/``.
+
+    Crash-safety contract: a pass is *completed* iff its manifest line
+    was fully written AND its spill file matches the recorded sha256.
+    The spill is written first (tmp file + fsync + atomic ``os.replace``),
+    the manifest line second (fsync'd append), so every crash point
+    leaves either a resumable state or an orphan spill that is simply
+    re-executed — never a manifest entry pointing at absent/garbage data
+    that would silently corrupt a resumed run (garbage fails the
+    checksum and is re-executed too)."""
+
+    def __init__(self, root: str, fingerprint: str, op: str):
+        self.fingerprint = fingerprint
+        self.op = op
+        self.dir = os.path.join(root, fingerprint)
+        self._passes: Dict[Tuple[int, int], dict] = {}
+        self._quarantined: List[dict] = []
+        self._last_committed: Optional[str] = None
+        self._spill_disabled = False
+
+    # -- open / manifest replay -----------------------------------------
+
+    @classmethod
+    def open_run(cls, fingerprint: str, op: str) -> Optional["RunJournal"]:
+        """Open (creating if needed) the journal for ``fingerprint``, or
+        None when durability is disabled — or when the journal root is
+        unusable (unwritable, not a directory, IO errors): best-effort
+        durability must never fail the run it exists to protect.  The
+        foreign-fingerprint refusal is NOT best-effort and propagates.
+        Replays the manifest so ``load_pass`` can serve completed
+        parts."""
+        global _LAST_JOURNAL
+        root = durable_dir()
+        if not root:
+            return None
+        j = cls(root, fingerprint, op)
+        try:
+            j._open()
+        except OSError as e:
+            obs_metrics.counter_add("durable.journal_errors")
+            log.warning("durable: cannot open journal under %r (%s: %s); "
+                        "journaling disabled for this run", root,
+                        type(e).__name__, e)
+            return None
+        _LAST_JOURNAL = j
+        return j
+
+    def _open(self) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        path = os.path.join(self.dir, MANIFEST)
+        header = None
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as fh:
+                for raw in fh:
+                    try:
+                        entry = json.loads(raw)
+                    except ValueError:
+                        # a torn tail line is the expected shape of a
+                        # crash mid-append; everything before it stands
+                        break
+                    kind = entry.get("kind")
+                    if kind == "run":
+                        header = entry
+                    elif kind == "pass":
+                        self._passes[(int(entry["level"]),
+                                      int(entry["part"]))] = entry
+                    elif kind == "quarantine":
+                        self._quarantined.append(entry)
+        if header is not None and header.get("fingerprint") != self.fingerprint:
+            # the dir is named by the fingerprint, so this means tampering
+            # or a collision — stale spills must never serve another run
+            raise CylonError(
+                Code.Invalid,
+                f"durable journal {self.dir} records fingerprint "
+                f"{header.get('fingerprint')!r} != this run's "
+                f"{self.fingerprint!r}: refusing stale spills")
+        if header is None:
+            try:
+                self._append({"kind": "run",
+                              "fingerprint": self.fingerprint,
+                              "op": self.op})
+            except OSError as e:
+                # journaling is best-effort: an unwritable journal must
+                # never fail the run it was meant to protect — loads (the
+                # resume path) still work, new spills are skipped
+                self._spill_disabled = True
+                log.warning("durable: manifest header write failed (%s: "
+                            "%s); journaling disabled for this run",
+                            type(e).__name__, e)
+        if self._passes:
+            log.info("durable: resuming run %s from %d journaled passes",
+                     self.fingerprint[:12], len(self._passes))
+            obs_spans.instant("durable.resume", op=self.op,
+                              journaled_passes=len(self._passes))
+            obs_metrics.counter_add("durable.resumes")
+
+    def _append(self, entry: dict) -> None:
+        with open(os.path.join(self.dir, MANIFEST), "a",
+                  encoding="utf-8") as fh:
+            fh.write(json.dumps(entry, sort_keys=True) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    # -- pass completion --------------------------------------------------
+
+    def completed_count(self) -> int:
+        return len(self._passes)
+
+    def completed(self, level: int, part: int) -> bool:
+        """True when the pass has a manifest record (cheap — no spill
+        read; the checksum is still verified at load time)."""
+        return (int(level), int(part)) in self._passes
+
+    def record_pass(self, level: int, part: int, frame: Dict[str, np.ndarray],
+                    rows: int) -> bool:
+        """Spill one completed pass's host frame and commit it to the
+        manifest; True iff the pass is now durably journaled.  Spill/
+        serialize failures disable journaling for the rest of the run
+        (counted, warned) — durability is best-effort and must never
+        fail a pass that already computed."""
+        if self._spill_disabled:
+            return False
+        from . import resilience
+        from .io import arrow_io
+
+        name = f"pass_L{level}_P{part}.arrow"
+        path = os.path.join(self.dir, name)
+        with obs_spans.span("durable.spill", level=level, part=part,
+                            rows=rows):
+            resilience.fault_point("journal_spill")
+            try:
+                payload = arrow_io.frame_to_ipc_bytes(frame)
+            except Exception as e:
+                self._spill_failed("serialize", name, e)
+                return False
+            digest = hashlib.sha256(payload).hexdigest()
+            tmp = path + f".tmp.{os.getpid()}"
+            try:
+                with open(tmp, "wb") as fh:
+                    fh.write(payload)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, path)
+            except OSError as e:
+                with contextlib.suppress(OSError):
+                    os.remove(tmp)
+                self._spill_failed("write", name, e)
+                return False
+            self._last_committed = path
+            # the killhard crash window the subprocess tests aim at:
+            # spill durable, completion not yet recorded -> the pass
+            # re-runs on resume (at-least-once, never lost)
+            resilience.fault_point("journal_commit")
+            entry = {"kind": "pass", "level": int(level), "part": int(part),
+                     "rows": int(rows), "file": name, "sha256": digest,
+                     "bytes": len(payload)}
+            try:
+                self._append(entry)
+            except OSError as e:
+                self._spill_failed("manifest commit", name, e)
+                return False
+            self._passes[(int(level), int(part))] = entry
+        obs_metrics.counter_add("durable.passes_journaled")
+        obs_metrics.counter_add("durable.spill_bytes", len(payload))
+        return True
+
+    def _spill_failed(self, stage: str, name: str, e: Exception) -> None:
+        self._spill_disabled = True
+        obs_metrics.counter_add("durable.spill_errors")
+        log.warning("durable: %s of %s failed (%s: %s); journaling disabled "
+                    "for the rest of this run", stage, name,
+                    type(e).__name__, e)
+
+    def load_pass(self, level: int, part: int):
+        """(frame, rows) for a journaled pass, or None when the pass is
+        not recorded — or its spill is missing/truncated/corrupt (checksum
+        mismatch), in which case the record is dropped so the pass simply
+        re-executes."""
+        entry = self._passes.get((int(level), int(part)))
+        if entry is None:
+            return None
+        from .io import arrow_io
+
+        path = os.path.join(self.dir, entry["file"])
+        with obs_spans.span("durable.load", level=level, part=part):
+            try:
+                with open(path, "rb") as fh:
+                    payload = fh.read()
+            except OSError as e:
+                return self._reject(level, part, f"unreadable spill: {e}")
+            if hashlib.sha256(payload).hexdigest() != entry["sha256"]:
+                return self._reject(level, part,
+                                    "checksum mismatch (truncated/corrupt)")
+            try:
+                frame = arrow_io.frame_from_ipc_bytes(payload)
+            except Exception as e:
+                return self._reject(level, part,
+                                    f"undecodable spill: "
+                                    f"{type(e).__name__}: {e}")
+        return frame, int(entry["rows"])
+
+    def _reject(self, level: int, part: int, why: str):
+        self._passes.pop((int(level), int(part)), None)
+        log.warning("durable: rejecting journaled pass L%d/P%d: %s "
+                    "(the pass will re-execute)", level, part, why)
+        obs_spans.instant("durable.spill_rejected", level=level, part=part,
+                          reason=why)
+        obs_metrics.counter_add("durable.spills_rejected")
+        return None
+
+    # -- quarantine record ------------------------------------------------
+
+    def record_quarantine(self, level: int, part: int, code: str,
+                          msg: str) -> None:
+        entry = {"kind": "quarantine", "level": int(level),
+                 "part": int(part), "code": code, "msg": msg}
+        self._quarantined.append(entry)
+        try:
+            self._append(entry)
+        except OSError as e:
+            log.warning("durable: quarantine record failed: %s", e)
+
+
+def open_run(fingerprint: str, op: str) -> Optional[RunJournal]:
+    """Module-level convenience over :meth:`RunJournal.open_run`."""
+    return RunJournal.open_run(fingerprint, op)
+
+
+def _corrupt_last_spill() -> None:
+    """Test hook behind the ``journal_corrupt`` fault kind: truncate the
+    most recently committed spill to half its size, so its manifest
+    checksum no longer matches — the corruption a resume must reject."""
+    j = _LAST_JOURNAL
+    path = j._last_committed if j is not None else None
+    if path is None or not os.path.exists(path):
+        return
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size // 2)
+    log.warning("durable: injected corruption truncated %s to %d bytes",
+                path, size // 2)
+
+
+# ---------------------------------------------------------------------------
+# pass deadlines
+# ---------------------------------------------------------------------------
+
+class _NullDeadline:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullDeadline":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def raise_if_fired(self) -> None:
+        return None
+
+    def accept_late(self) -> None:
+        return None
+
+
+_NULL_DEADLINE = _NullDeadline()
+
+
+class PassDeadline:
+    """Watchdog for one pass: a timer thread fires ``deadline.fired``
+    (obs instant + metric) the moment ``seconds`` elapses — real-time
+    visibility even while the main thread is wedged in a native call —
+    and :meth:`raise_if_fired` classifies the overrun as `Code.Timeout`,
+    which the streaming loop retries like any transient.
+
+    The raise is deliberately NOT in ``__exit__``: the caller decides
+    between :meth:`raise_if_fired` (after journaling the late-but-correct
+    frame, so the Timeout retry serves it from the journal instead of
+    re-executing an identically-slow pass forever) and
+    :meth:`accept_late` (no journal to serve the retry from — keep the
+    completed frame, record the overrun, and move on; discarding it
+    would condemn every consistently-slow pass to retry-until-fatal).
+    Either way a late result is never lost work.  An exception already
+    in flight wins over the deadline (its own classification is more
+    specific than "late")."""
+
+    def __init__(self, seconds: float, site: str):
+        self.seconds = seconds
+        self.site = site
+        self.fired = threading.Event()
+        self._timer: Optional[threading.Timer] = None
+
+    def _fire(self) -> None:
+        self.fired.set()
+        obs_spans.instant("deadline.fired", site=self.site,
+                          deadline_s=self.seconds)
+        obs_metrics.counter_add("deadline.fired")
+        log.warning("durable: pass deadline %.3fs exceeded at %s "
+                    "(CYLON_TPU_PASS_DEADLINE_S)", self.seconds, self.site)
+
+    def __enter__(self) -> "PassDeadline":
+        self._timer = threading.Timer(self.seconds, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._timer is not None:
+            self._timer.cancel()
+        return False
+
+    def raise_if_fired(self) -> None:
+        """Classify a recorded overrun as `Code.Timeout` (call after the
+        block — and after journaling any completed frame)."""
+        if self.fired.is_set():
+            raise CylonError(
+                Code.Timeout,
+                f"pass exceeded CYLON_TPU_PASS_DEADLINE_S="
+                f"{self.seconds:g}s at {self.site}")
+
+    def accept_late(self) -> None:
+        """Keep a late-but-complete result: record the overrun (instant +
+        metric) without raising — the path for work that is NOT journaled
+        and would otherwise be discarded just to re-run identically."""
+        if self.fired.is_set():
+            obs_spans.instant("deadline.accepted_late", site=self.site,
+                              deadline_s=self.seconds)
+            obs_metrics.counter_add("deadline.accepted_late")
+            log.warning("durable: pass exceeded its %.3fs deadline but "
+                        "completed and is not journaled; keeping the late "
+                        "result at %s", self.seconds, self.site)
+
+
+def pass_deadline(site: str = "exec.pass"):
+    """Armed :class:`PassDeadline` when ``CYLON_TPU_PASS_DEADLINE_S`` is
+    set, else a shared no-op context (zero allocation on the hot path)."""
+    s = deadline_s()
+    if s <= 0:
+        return _NULL_DEADLINE
+    return PassDeadline(s, site)

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import column as colmod
+from . import durable
 from . import resilience
 from . import config
 from .obs import metrics as obs_metrics
@@ -584,7 +585,7 @@ class _RefinablePlan:
 
 
 def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
-                        prefetch=True, progress=True):
+                        prefetch=True, progress=True, journal=None):
     """The resilient streaming loop: checkpointed host frames + adaptive
     pass-splitting + bounded transient retry.
 
@@ -594,6 +595,14 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
     frames are kept across rebuilds, so recovery RESUMES the stream at
     the failed part instead of restarting it.
 
+    With a ``journal`` (`durable.RunJournal`) the checkpoint outlives the
+    process: every completed pass's frame spills to disk and is recorded
+    in the run manifest, parts the journal already holds are LOADED
+    instead of re-executed (``stats["passes_skipped"]``, metric
+    ``durable.passes_skipped``) — a fresh process re-invoking the same
+    fingerprinted run resumes mid-plan, surviving ``kill -9``.  A fully
+    journaled run never even compiles.
+
     Failure handling, by classified code (`Status.from_exception`):
     - `Code.OutOfMemory` — every remaining part splits in two (``plan``)
       and the level's execution is rebuilt at roughly half the chunk
@@ -601,9 +610,16 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
       `CylonError(Code.OutOfMemory)` is raised.  ``plan=None`` (callers
       whose pass order is not refinable, e.g. the global sort) disables
       splitting and propagates the failure.
-    - `Code.ExecutionError` (transient comm/deadline) — the failing part
+    - `Code.ExecutionError` / `Code.Timeout` (transient comm, or a pass
+      deadline fired by ``durable.pass_deadline``) — the failing part
       retries in place under ``policy``'s exponential backoff.
     - anything else — propagates unchanged (a TypeError stays a bug).
+
+    Poison-pass quarantine (``CYLON_TPU_QUARANTINE_AFTER`` = N > 0): a
+    head part failing with the SAME classified code N consecutive times
+    is dropped from the stream and reported in ``stats["quarantined"]``
+    (and the journal) instead of wedging retries/refinement forever.
+    Only recoverable codes qualify — an unknown code stays a bug.
 
     Returns ``(t_plan, t_run0, frames, total)`` like the old fixed loop.
     """
@@ -619,33 +635,110 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
     level = 0
     part_retries = 0  # transient retries of the current head part
     atom_watch: set = set()  # child ids of a head atom already split once
+    fail_key = None  # (code, level, head part): quarantine failure tracking
+    fail_count = 0
     t_plan = None
     t_run0 = time.perf_counter()
     exec_cache: Dict[int, tuple] = {}
+    if journal is not None:
+        stats.setdefault("passes_skipped", 0)
+
+    def consume_journaled(part: int, hit) -> None:
+        """Append a journal-loaded pass frame in place of executing it.
+        Serving a part IS completing it, so the head-part retry/failure
+        state resets exactly as it would after an executed pass — the
+        next part must start with its full budgets."""
+        nonlocal total, part_retries, fail_key, fail_count
+        frame, n = hit
+        frames.append(frame)
+        total += int(n)
+        part_retries = 0
+        fail_key, fail_count = None, 0
+        stats["passes_skipped"] += 1
+        obs_spans.instant("durable.pass_skipped", part=int(part),
+                          level=level, rows=int(n))
+        obs_metrics.counter_add("durable.passes_skipped")
+
+    def quarantine_head(st: Status, msg: str) -> bool:
+        """Isolate the head part into the run report (poison-pass
+        quarantine); False when quarantine is off, nothing remains, or
+        the code is not a recoverable kind (a TypeError stays a bug)."""
+        nonlocal remaining, part_retries, fail_key, fail_count
+        if durable.quarantine_after() <= 0 or not remaining:
+            return False
+        if not (st.code == Code.OutOfMemory
+                or st.code in resilience.RETRYABLE_CODES):
+            return False
+        part = remaining[0]
+        entry = {"part": int(part), "level": level, "code": st.code.name,
+                 "failures": fail_count, "msg": msg}
+        stats.setdefault("quarantined", []).append(entry)
+        if journal is not None:
+            journal.record_quarantine(level, part, st.code.name, msg)
+        obs_spans.instant("exec.part_quarantined", part=int(part),
+                          level=level, code=st.code.name)
+        obs_metrics.counter_add("quarantine.parts")
+        remaining = remaining[1:]
+        part_retries = 0
+        fail_key, fail_count = None, 0
+        return True
 
     def recover(e: Exception) -> None:
         """Adjust (remaining, level) for a recoverable failure or raise."""
-        nonlocal remaining, level, part_retries
+        nonlocal remaining, level, part_retries, fail_key, fail_count
         st = Status.from_exception(e)
+        if (journal is not None and remaining
+                and (st.code == Code.OutOfMemory
+                     or st.code in resilience.RETRYABLE_CODES)
+                and journal.completed(level, remaining[0])):
+            # the failing part's result is already durably journaled (a
+            # deadline overrun classified AFTER its commit): the loop
+            # re-enters and serves it from the journal — no retry budget,
+            # no backoff, no quarantine, cannot be fatal.  Checked FIRST:
+            # a part whose correct frame sits in the journal must never
+            # be quarantined out of the output
+            obs_spans.instant("exec.pass_served_from_journal",
+                              part=int(remaining[0]), level=level,
+                              code=st.code.name)
+            return
+        # the counter is keyed to the PART's identity, not just the code:
+        # an OOM split advances the level (the head's first child keeps
+        # its id one level up), so productive refinement starts a fresh
+        # count instead of accumulating toward quarantine
+        key = (st.code, level, remaining[0] if remaining else None)
+        if key == fail_key:
+            fail_count += 1
+        else:
+            fail_key, fail_count = key, 1
+        # poison-pass quarantine fires EARLY once the head has failed the
+        # same way N consecutive times, and LATE at any point a failure
+        # would otherwise be fatal (retry/split budgets exhausted, atoms)
+        # — so the knob works regardless of how it compares to the retry
+        # budget, and a poisoned part never wedges or kills the stream
+        qn = durable.quarantine_after()
+        if qn > 0 and fail_count >= qn and quarantine_head(st, st.msg):
+            return
         if st.code == Code.OutOfMemory and plan is not None:
             if level >= max_splits:
-                raise CylonError(
-                    Code.OutOfMemory,
-                    f"pass still exceeds device memory after {level} "
-                    f"pass-doublings (CYLON_TPU_MAX_OOM_SPLITS="
-                    f"{max_splits}): {st.msg}") from e
+                msg = (f"pass still exceeds device memory after {level} "
+                       f"pass-doublings (CYLON_TPU_MAX_OOM_SPLITS="
+                       f"{max_splits}): {st.msg}")
+                if quarantine_head(st, msg):
+                    return
+                raise CylonError(Code.OutOfMemory, msg) from e
             # progress check: a split that moves no rows rebuilds an
             # identically-sized program that must OOM again — fail fast
             # instead of burning the whole split budget on no-ops
             moved = plan.parts_redistributing(remaining, level)
             if not moved.any():
                 atom_l, atom_r = plan.max_part_rows(remaining, level)
-                raise CylonError(
-                    Code.OutOfMemory,
-                    f"splitting cannot shrink the failing pass: the "
-                    f"remaining parts (largest {atom_l}+{atom_r} rows) "
-                    f"are key-domain atoms (single hot key or shared "
-                    f"range prefix): {st.msg}") from e
+                msg = (f"splitting cannot shrink the failing pass: the "
+                       f"remaining parts (largest {atom_l}+{atom_r} rows) "
+                       f"are key-domain atoms (single hot key or shared "
+                       f"range prefix): {st.msg}")
+                if quarantine_head(st, msg):
+                    return
+                raise CylonError(Code.OutOfMemory, msg) from e
             # the FAILING head part may be an atom even when later parts
             # split: allow it ONE split (a smaller output capacity from
             # the other parts can heal an output-driven OOM), then stop.
@@ -657,12 +750,13 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
                 if head in atom_watch:
                     atom_l, atom_r = plan.max_part_rows(remaining[:1],
                                                         level)
-                    raise CylonError(
-                        Code.OutOfMemory,
-                        f"splitting cannot shrink the failing pass: its "
-                        f"{atom_l}+{atom_r} rows are one key-domain atom "
-                        f"(single hot key or shared range prefix): "
-                        f"{st.msg}") from e
+                    msg = (f"splitting cannot shrink the failing pass: "
+                           f"its {atom_l}+{atom_r} rows are one "
+                           f"key-domain atom (single hot key or shared "
+                           f"range prefix): {st.msg}")
+                    if quarantine_head(st, msg):
+                        return
+                    raise CylonError(Code.OutOfMemory, msg) from e
                 atom_watch.clear()
                 atom_watch.update((head, head + plan.part_count(level)))
             else:
@@ -682,10 +776,11 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
             return
         if st.code in resilience.RETRYABLE_CODES:
             if part_retries >= policy.max_retries:
-                raise CylonError(
-                    st.code,
-                    f"pass retries exhausted after {part_retries + 1} "
-                    f"attempts: {st.msg}") from e
+                msg = (f"pass retries exhausted after {part_retries + 1} "
+                       f"attempts: {st.msg}")
+                if quarantine_head(st, msg):
+                    return
+                raise CylonError(st.code, msg) from e
             d = policy.delay(part_retries)
             part_retries += 1
             stats["retries"] = stats.get("retries", 0) + 1
@@ -698,6 +793,21 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
         raise e
 
     while remaining is None or remaining:
+        if journal is not None:
+            if remaining is None and "passes" in stats:
+                remaining = list(range(stats["passes"]))
+            # consume the journaled prefix BEFORE building this level's
+            # execution: execution is sequential, so a prior (crashed)
+            # process's completions at this level always form a prefix —
+            # and a fully journaled run must not compile at all
+            while remaining:
+                hit = journal.load_pass(level, remaining[0])
+                if hit is None:
+                    break
+                consume_journaled(remaining[0], hit)
+                remaining = remaining[1:]
+            if not remaining:
+                break
         try:
             ex = exec_cache.get(level)
             if ex is None:
@@ -717,16 +827,26 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
         try:
             nxt = chunk(remaining[0]) if prefetch else None
             while cursor < len(remaining):
-                with obs_spans.span("exec.pass", part=remaining[cursor],
+                part = remaining[cursor]
+                if journal is not None:
+                    hit = journal.load_pass(level, part)
+                    if hit is not None:  # rejected-spill gaps re-ran; the
+                        consume_journaled(part, hit)  # rest still skips
+                        cursor += 1
+                        nxt = None  # prefetched chunk was for this part
+                        continue
+                deadline = durable.pass_deadline()
+                with obs_spans.span("exec.pass", part=part,
                                     level=level) as sp:
-                    resilience.fault_point("pass_dispatch")
-                    cur = nxt if nxt is not None else chunk(remaining[cursor])
-                    fut = prog(*cur)                   # async dispatch
-                    nxt = (chunk(remaining[cursor + 1])
-                           if prefetch and cursor + 1 < len(remaining)
-                           else None)
-                    resilience.fault_point("host_fetch")
-                    frame, n = fetch(fut)  # blocks; device errors land here
+                    with deadline:
+                        resilience.fault_point("pass_dispatch")
+                        cur = nxt if nxt is not None else chunk(part)
+                        fut = prog(*cur)               # async dispatch
+                        nxt = (chunk(remaining[cursor + 1])
+                               if prefetch and cursor + 1 < len(remaining)
+                               else None)
+                        resilience.fault_point("host_fetch")
+                        frame, n = fetch(fut)  # blocks; device errors here
                     if obs_spans.events_enabled():
                         sp.set(rows=int(n), bytes=int(sum(
                             a.nbytes for a in frame.values())))
@@ -738,10 +858,30 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
                         # the always-on default pays it once per level,
                         # not once per pass
                         obs_metrics.record_hbm_watermark()
+                committed = False
+                if journal is not None:
+                    # spill + manifest-commit BEFORE the frame counts as
+                    # done: a crash inside the journal write re-runs the
+                    # pass on resume (at-least-once, never lost)
+                    committed = journal.record_pass(level, part, frame,
+                                                    int(n))
+                if committed:
+                    # a deadline overrun classifies AFTER the late frame
+                    # is journaled: the Timeout retry serves the result
+                    # from the journal instead of re-executing an
+                    # identically-slow pass forever
+                    deadline.raise_if_fired()
+                else:
+                    # no journal to serve a retry from: discarding the
+                    # late-but-correct frame would condemn every
+                    # consistently-slow pass to retry-until-fatal, so
+                    # keep it and record the overrun
+                    deadline.accept_late()
                 total += n
                 frames.append(frame)
                 cursor += 1
                 part_retries = 0
+                fail_key, fail_count = None, 0
                 stats["parts_run"] = stats.get("parts_run", 0) + 1
                 obs_metrics.counter_add("exec.parts_run")
                 cur = fut = None
@@ -769,7 +909,7 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
 
 
 def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0, *,
-                policy=None, stats=None):
+                policy=None, stats=None, journal=None):
     """Streaming loop over positional passes 0..n-1 with transient-retry
     resilience (no OOM splitting: callers on this entry — the global sort
     — emit passes in an order a hash subdivision would scramble).
@@ -787,7 +927,7 @@ def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0, *,
         return chunk, prog, fetch
 
     return _stream_recoverable(make_exec, None, t0, policy=policy,
-                               stats=stats)
+                               stats=stats, journal=journal)
 
 
 def _concat_host(frames: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -977,6 +1117,22 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
     stats = {"passes": n_passes, "mode": mode_used,
              "chunk_cap": max(cap_l, cap_r), "cap_l": cap_l, "cap_r": cap_r,
              "world": 1}
+    journal = None
+    if durable.enabled():
+        # run identity: op shape x realized plan x sampled input content
+        # x result-affecting knob config — a resumed process recomputes
+        # the identical fingerprint and reopens the same journal
+        op = "join" if gb_names is None else "join_groupby"
+        fp = durable.run_fingerprint(
+            op,
+            (tuple(lon), tuple(ron), int(jt), int(cfg.algorithm),
+             cfg.left_prefix, cfg.right_prefix,
+             tuple(gb_names) if gb_names is not None else None,
+             tuple((n, int(o)) for n, o in aggs_req)
+             if aggs_req is not None else None,
+             int(ddof), int(n_passes), mode_used, 1),
+            ((names_l, arrs_l), (names_r, arrs_r)))
+        journal = durable.open_run(fp, op)
 
     def make_exec(parts, level):
         pid_l_lvl, pid_r_lvl = plan.pids(level)
@@ -1012,7 +1168,7 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
 
     t_plan, t_run0, frames, total = _stream_recoverable(
         make_exec, plan, t_plan0, policy=policy, stats=stats,
-        prefetch=prefetch)
+        prefetch=prefetch, journal=journal)
     result = _concat_host(frames)
     if gb_names is not None and not final_per_pass:
         result, total = _combine_partials(result, gb_names, aggs_req,
@@ -1247,6 +1403,15 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
         plan = _RefinablePlan(pid, np.zeros(0, np.int32), n_passes,
                               mode_used, key_arrs, [])
         extra: Dict = {}
+        journal = None
+        if durable.enabled():
+            fp = durable.run_fingerprint(
+                "groupby",
+                (tuple(by_names),
+                 tuple((n, int(o)) for n, o in aggs_req),
+                 int(ddof), int(n_passes), mode_used, 1),
+                ((names, arrs),))
+            journal = durable.open_run(fp, "groupby")
 
         def make_exec(parts, level):
             pid_lvl, _ = plan.pids(level)
@@ -1265,7 +1430,7 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
             return build.chunk, prog, fetch
 
         t_plan, t_run0, frames, total = _stream_recoverable(
-            make_exec, plan, t0, stats=extra)
+            make_exec, plan, t0, stats=extra, journal=journal)
     result = _concat_host(frames)
     t_run = time.perf_counter() - t_run0
     stats = {"passes": n_passes, "mode": mode_used, "world": world,
@@ -1546,14 +1711,28 @@ def chunked_sort(data, by, *, ascending=True, nulls_first: bool = True,
             return {name: colmod.to_numpy(c, n)
                     for name, c in zip(names, scols)}, n
 
+        journal = None
+        if durable.enabled():
+            # positional passes (no refinement), keyed by emit position
+            fp = durable.run_fingerprint(
+                "sort",
+                (tuple(by_names), tuple(asc), bool(nulls_first),
+                 int(n_passes), 1),
+                ((names, arrs),))
+            journal = durable.open_run(fp, "sort")
+        extra = {}
         t_plan, t_run0, frames, total = _run_passes(
             prog, build.empty_chunk, lambda p: build.chunk(emit_order[p]),
-            n_passes, fetch, t0)
+            n_passes, fetch, t0, stats=extra, journal=journal)
     result = _concat_host(frames)
     t_run = time.perf_counter() - t_run0
     stats = {"passes": n_passes, "mode": "range", "world": world,
              "rows": total, "plan_seconds": t_plan, "run_seconds": t_run,
              "total_seconds": t_plan + t_run}
+    if world == 1:
+        for k in ("passes_skipped", "quarantined", "retries"):
+            if k in extra:
+                stats[k] = extra[k]
     return result, stats
 
 

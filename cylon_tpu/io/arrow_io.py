@@ -88,6 +88,149 @@ def _read_csv_native(path: PathLike, options: CSVReadOptions):
     return names, cols
 
 
+# ---------------------------------------------------------------------------
+# durable-execution frame spills (cylon_tpu.durable)
+# ---------------------------------------------------------------------------
+#
+# A chunked-run pass frame is a dict of host numpy columns exactly as
+# ``column.to_numpy`` produced them: plain fixed-width arrays, or object
+# arrays of str/bytes/np-scalars with ``None`` under nulls.  The spill
+# must round-trip BIT-IDENTICALLY (dtype included) or a resumed run's
+# concatenated output would differ from an uninterrupted run's — so each
+# Arrow field carries the exact numpy dtype (and, for object columns,
+# the element kind) in its metadata, and fixed-width object columns are
+# restored straight from the Arrow buffers (NaN payloads preserved)
+# rather than through Python scalars.
+
+_META_DTYPE = b"cylon_numpy_dtype"
+_META_KIND = b"cylon_value_kind"     # object columns: str|bytes|fixed|null
+_META_VDT = b"cylon_value_dtype"     # object 'fixed' columns: element dtype
+
+
+def _obj_column_to_arrow(a, meta):
+    import numpy as np
+    import pyarrow as pa
+
+    isnull = np.fromiter((x is None for x in a), bool, count=len(a))
+    vals = a[~isnull]
+    if vals.size == 0:
+        meta[_META_KIND] = b"null"
+        return pa.array([None] * len(a), type=pa.null())
+    if all(isinstance(x, (str, np.str_)) for x in vals):
+        meta[_META_KIND] = b"str"
+        return pa.array([None if m else str(x) for x, m in zip(a, isnull)],
+                        type=pa.string())
+    if all(isinstance(x, (bytes, np.bytes_)) for x in vals):
+        meta[_META_KIND] = b"bytes"
+        return pa.array([None if m else bytes(x) for x, m in zip(a, isnull)],
+                        type=pa.binary())
+    # uniform numeric/temporal scalars under the nulls (the
+    # ``vals.astype(object)`` shape to_numpy emits).  Uniformity is
+    # CHECKED, not assumed: numpy assignment would silently cast a
+    # mixed column (f64 after f32 rounds, i64 after i32 wraps) and the
+    # checksum would bless the corrupted payload — raising here routes
+    # the column through the journal's skip-this-spill path instead
+    vdt = np.asarray(vals[0]).dtype
+    for x in vals:
+        if np.asarray(x).dtype != vdt:
+            raise CylonError(
+                Code.SerializationError,
+                f"mixed object-column element dtypes ({vdt} vs "
+                f"{np.asarray(x).dtype}): frame spill would not "
+                f"round-trip bit-exactly")
+    values = np.zeros(len(a), vdt)
+    values[~isnull] = vals
+    meta[_META_KIND] = b"fixed"
+    meta[_META_VDT] = vdt.str.encode()
+    return pa.array(values, mask=isnull)
+
+
+def frame_to_ipc_bytes(frame) -> bytes:
+    """Serialize one pass frame (dict of host numpy columns) to Arrow IPC
+    file bytes, tagging every field with the numpy dtype needed for an
+    exact restore."""
+    import numpy as np
+    import pyarrow as pa
+
+    arrays, fields = [], []
+    for name, arr in frame.items():
+        a = np.asarray(arr)
+        meta = {_META_DTYPE: a.dtype.str.encode()}
+        if a.dtype.kind == "O":
+            pa_arr = _obj_column_to_arrow(a, meta)
+        elif a.dtype.kind == "U":
+            pa_arr = pa.array(a.astype(object), type=pa.string())
+        elif a.dtype.kind == "S":
+            pa_arr = pa.array([bytes(x) for x in a], type=pa.binary())
+        else:
+            pa_arr = pa.array(a)
+        arrays.append(pa_arr)
+        fields.append(pa.field(str(name), pa_arr.type, metadata=meta))
+    schema = pa.schema(fields)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_file(sink, schema) as writer:
+        writer.write_batch(pa.record_batch(arrays, schema=schema))
+    return sink.getvalue().to_pybytes()
+
+
+def _bitmap_to_bool(buf, n, offset):
+    import numpy as np
+
+    if buf is None:
+        return np.ones(n, bool)
+    bits = np.unpackbits(np.frombuffer(buf, np.uint8), bitorder="little")
+    return bits[offset:offset + n].astype(bool)
+
+
+def _obj_column_from_arrow(arr, meta):
+    import numpy as np
+
+    kind = meta.get(_META_KIND, b"").decode()
+    n = len(arr)
+    if kind in ("str", "bytes", "null"):
+        out = np.empty(n, object)
+        out[:] = arr.to_pylist()
+        return out
+    if kind == "fixed":
+        vdt = np.dtype(meta[_META_VDT].decode())
+        if arr.offset == 0 and vdt.kind not in "b":
+            vals = np.frombuffer(arr.buffers()[1], dtype=vdt)[:n]
+            valid = _bitmap_to_bool(arr.buffers()[0], n, 0)
+        else:  # sliced or bit-packed layouts take the scalar path
+            valid = np.asarray([v.is_valid for v in arr], bool)
+            vals = np.zeros(n, vdt)
+            lst = arr.to_pylist()
+            for i in np.nonzero(valid)[0]:
+                vals[i] = lst[i]
+        out = vals.astype(object)
+        out[~valid] = None
+        return out
+    raise CylonError(Code.SerializationError,
+                     f"unknown object-column kind {kind!r} in frame spill")
+
+
+def frame_from_ipc_bytes(payload: bytes):
+    """Inverse of :func:`frame_to_ipc_bytes`: Arrow IPC file bytes back to
+    the exact dict of numpy columns that was spilled."""
+    import numpy as np
+    import pyarrow as pa
+
+    table = pa.ipc.open_file(pa.BufferReader(payload)).read_all()
+    out = {}
+    for field in table.schema:
+        arr = table.column(field.name).combine_chunks()
+        meta = dict(field.metadata or {})
+        dt = np.dtype(meta[_META_DTYPE].decode())
+        if dt.kind == "O":
+            out[field.name] = _obj_column_from_arrow(arr, meta)
+        elif dt.kind in "US":
+            out[field.name] = np.array(arr.to_pylist(), dtype=dt)
+        else:
+            out[field.name] = arr.to_numpy(zero_copy_only=False) \
+                .astype(dt, copy=False)
+    return out
+
+
 def _read_parquet_arrow(path: PathLike):
     import pyarrow.parquet as pq
 

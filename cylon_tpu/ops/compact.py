@@ -160,9 +160,14 @@ def inverse_permute(perm: jax.Array, *fields: jax.Array) -> Tuple[jax.Array, ...
     if permute_mode() == "sort":
         if invperm_mode() == "gather":
             cap = perm.shape[0]
-            iota = jnp.arange(cap, dtype=jnp.int32)  # payload: no cast back
-            _, inv = jax.lax.sort((perm.astype(jnp.uint32), iota),
-                                  num_keys=1, is_stable=False)
+            # index dtype must widen with cap like _mask_sort_perm's
+            # fallback: an int32 iota (and a u32 key cast) silently wraps
+            # for cap >= 2^31, scrambling the inverse permutation
+            it = _idx_dtype(cap)
+            iota = jnp.arange(cap, dtype=it)  # payload: no cast back
+            key = (perm.astype(jnp.uint32) if it == jnp.int32
+                   else perm.astype(jnp.int64))
+            _, inv = jax.lax.sort((key, iota), num_keys=1, is_stable=False)
             # inv is an argsort of a permutation — provably in bounds and
             # unique; the default fill mode would add a clamp+select per
             # element inside the very A/B this realization exists to win

@@ -38,6 +38,7 @@ e.g. ``CYLON_TPU_FAULT_PLAN="pass_dispatch@2=oom;probe_spawn@1=timeout"``.
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -50,7 +51,9 @@ from .status import Code, CylonError, Status
 # Codes a plain bounded retry may heal.  OutOfMemory is deliberately
 # absent: repeating an identical allocation cannot succeed — the engine
 # heals OOM by splitting the remaining key-domain parts instead.
-RETRYABLE_CODES = frozenset({Code.ExecutionError})
+# Timeout (a pass-deadline overrun, durable.PassDeadline) retries like
+# any transient: the hung collective/fetch may simply have been late.
+RETRYABLE_CODES = frozenset({Code.ExecutionError, Code.Timeout})
 
 
 def max_oom_splits() -> int:
@@ -144,6 +147,13 @@ _KIND_MESSAGES = {
     "comm": ("UNAVAILABLE: injected fault at {site} (hit {hit}): "
              "connection reset by peer"),
     "unknown": "INTERNAL: injected fault at {site} (hit {hit})",
+    # non-raising kinds (durable-execution tests): `killhard` os._exit()s
+    # the process at the probe (a kill -9 cannot be raised past),
+    # `journal_corrupt` truncates the last committed spill and continues,
+    # `hang` sleeps the probe past the active pass deadline
+    "killhard": "injected hard kill at {site} (hit {hit})",
+    "journal_corrupt": "injected spill corruption at {site} (hit {hit})",
+    "hang": "injected hang at {site} (hit {hit})",
 }
 
 FAULT_KINDS = tuple(_KIND_MESSAGES)
@@ -271,6 +281,20 @@ def fault_point(site: str) -> None:
         obs_spans.instant("fault.injected", site=site, kind=kind,
                           hit=plan.hits[site])
         obs_metrics.counter_add("fault.injected")
+        if kind == "killhard":
+            # simulate kill -9 / preemption: no cleanup, no atexit, no
+            # flushed buffers — exactly what the journal must survive
+            os._exit(137)
+        if kind == "journal_corrupt":
+            from . import durable
+
+            durable._corrupt_last_spill()
+            return
+        if kind == "hang":
+            from . import durable
+
+            time.sleep(max(1.5 * durable.deadline_s(), 0.05))
+            return
         raise InjectedFault(site, kind, plan.hits[site])
 
 

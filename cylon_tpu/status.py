@@ -32,6 +32,7 @@ class Code(enum.IntEnum):
     ExpressionValidationError = 41
     ExecutionError = 42
     AlreadyExists = 45
+    Timeout = 46
 
 
 # Failure-text classification tables (lowercase substrings).  PJRT raises

@@ -1228,9 +1228,18 @@ def _oneshot_oom_fallback(left: Table, right: Optional[Table],
         return False
     import logging
 
+    from . import durable
+    from .obs import instant as obs_instant
+
+    # the fallback run rides the chunked engine, so with a durable dir
+    # set it is journaled and crash-resumable — record which, so a trace
+    # shows whether a later kill would lose the recovery work
+    obs_instant("table.oneshot_fallback", durable=durable.enabled())
     logging.getLogger(__name__).warning(
         "one-shot device program exceeded memory (%s); falling back to the "
-        "chunked out-of-core engine", type(exc).__name__)
+        "chunked out-of-core engine%s", type(exc).__name__,
+        " (journaled: CYLON_TPU_DURABLE_DIR set)" if durable.enabled()
+        else "")
     return True
 
 

@@ -49,9 +49,12 @@ def run(sf: float = 0.01, world: int | None = None, seed: int = 0,
                     {"revenue": ["sum"]})
     # the ORDER BY runs IN ENGINE (multi-key, mixed ascending — the
     # DistributedSort analog this example exists to exercise); only the
-    # LIMIT 10 materializes on host
-    ordered = g.distributed_sort(["sum_revenue", "o_orderdate"],
-                                 ascending=[False, True])
+    # LIMIT 10 materializes on host.  l_orderkey tie-breaks BOTH
+    # orderings: engine revenue is f32, pandas f64, so near-ties at the
+    # top-10 boundary could otherwise swap rank between the two
+    ordered = g.distributed_sort(["sum_revenue", "o_orderdate",
+                                  "l_orderkey"],
+                                 ascending=[False, True, True])
     res = ordered.to_pandas().head(TOP_K).reset_index(drop=True)
     dt = time.perf_counter() - t0
 
@@ -69,8 +72,8 @@ def run(sf: float = 0.01, world: int | None = None, seed: int = 0,
         j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
         exp = (j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
                .revenue.sum().reset_index()
-               .sort_values(["revenue", "o_orderdate"],
-                            ascending=[False, True])
+               .sort_values(["revenue", "o_orderdate", "l_orderkey"],
+                            ascending=[False, True, True])
                .head(TOP_K).reset_index(drop=True))
         assert len(res) == len(exp), (len(res), len(exp))
         np.testing.assert_array_equal(res["l_orderkey"].to_numpy(),

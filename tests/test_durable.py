@@ -1,0 +1,583 @@
+"""Durable execution (cylon_tpu/durable.py): journaled spill-to-disk
+checkpoints, cross-process crash-resume, pass deadlines, and poison-pass
+quarantine.
+
+The acceptance-criterion path: a run killed hard (``os._exit`` inside
+the journal commit — indistinguishable from ``kill -9``) mid-plan,
+re-invoked in a FRESH process, completes from the journal with
+bit-identical results to an uninterrupted run while re-executing only
+the unfinished parts (``durable.passes_skipped``).  Everything runs
+deterministically on CPU via the resilience fault plans.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cylon_tpu import config, durable, resilience
+from cylon_tpu.exec import (chunked_groupby, chunked_join_groupby_tables,
+                            chunked_sort)
+from cylon_tpu.io import arrow_io
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.obs import spans as obs_spans
+from cylon_tpu.status import Code, CylonError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _join_inputs(rng, n=3000):
+    left = {"k": rng.integers(0, n, n).astype(np.int64),
+            "a": rng.random(n).astype(np.float32)}
+    right = {"k": rng.integers(0, n, n).astype(np.int64),
+             "b": rng.random(n).astype(np.float32)}
+    return left, right
+
+
+def _run(left, right, passes=4):
+    return chunked_join_groupby_tables(
+        left, right, on="k", how="inner", group_by="l_k",
+        agg={"a": ["sum"], "b": ["mean"]}, passes=passes, mode="hash")
+
+
+def _assert_bit_identical(a: dict, b: dict) -> None:
+    assert set(a) == set(b)
+    for k in a:
+        x, y = np.asarray(a[k]), np.asarray(b[k])
+        assert x.dtype == y.dtype, (k, x.dtype, y.dtype)
+        np.testing.assert_array_equal(x, y, err_msg=k)
+        if x.dtype.kind == "f":  # equal NaNs aren't enough: same BITS
+            np.testing.assert_array_equal(x.view(np.uint8), y.view(np.uint8),
+                                          err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# frame spill round trip + checksum rejection
+# ---------------------------------------------------------------------------
+
+def test_frame_ipc_roundtrip_exact():
+    """Every frame shape ``column.to_numpy`` emits survives the Arrow IPC
+    spill bit-identically — dtype included (an object column must come
+    back object, or a resumed concat would change the output dtype)."""
+    frame = {
+        "i64": np.array([1, -2, 2**62], np.int64),
+        "i32": np.array([7, -7, 0], np.int32),
+        "f32": np.array([1.5, np.nan, -0.0], np.float32),
+        "f64": np.array([np.pi, np.inf, -np.inf], np.float64),
+        "bool": np.array([True, False, True]),
+        "dt": np.array(["2020-01-01", "NaT", "1970-01-02"], "datetime64[us]"),
+        "u": np.array(["xy", "", "abc"], "U3"),
+        "obj_f64": np.array([np.float64(2.5), None, np.float64(np.nan)],
+                            object),
+        "obj_i64": np.array([np.int64(5), None, np.int64(-5)], object),
+        "obj_str": np.array(["a", None, "ccc"], object),
+        "obj_bytes": np.array([b"\xff\x00", None, b"ok"], object),
+        "obj_null": np.array([None, None, None], object),
+    }
+    back = arrow_io.frame_from_ipc_bytes(arrow_io.frame_to_ipc_bytes(frame))
+    assert set(back) == set(frame)
+    for k, a in frame.items():
+        b = back[k]
+        assert b.dtype == a.dtype, (k, a.dtype, b.dtype)
+        if a.dtype == object:
+            for x, y in zip(a, b):
+                if x is None:
+                    assert y is None, k
+                elif isinstance(x, float) and np.isnan(x):
+                    assert np.isnan(y), k
+                else:
+                    assert x == y, k
+                    assert np.asarray(x).dtype == np.asarray(y).dtype, k
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=k)
+            if a.dtype.kind == "f":
+                np.testing.assert_array_equal(a.view(np.uint8),
+                                              b.view(np.uint8), err_msg=k)
+
+
+def test_frame_ipc_empty_and_zero_rows():
+    for frame in ({}, {"x": np.zeros(0, np.int32),
+                       "s": np.zeros(0, object)}):
+        back = arrow_io.frame_from_ipc_bytes(
+            arrow_io.frame_to_ipc_bytes(frame))
+        assert set(back) == set(frame)
+        for k in frame:
+            assert back[k].dtype == np.asarray(frame[k]).dtype
+            assert len(back[k]) == 0
+
+
+def test_journal_checksum_rejects_truncated_spill(tmp_path):
+    """A spill truncated after commit (torn write, disk corruption) fails
+    its manifest checksum on load and the pass re-executes — never served
+    as garbage."""
+    frame = {"k": np.arange(10, dtype=np.int64),
+             "v": np.linspace(0, 1, 10).astype(np.float32)}
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        j = durable.open_run("f" * 64, "test")
+        j.record_pass(0, 0, frame, 10)
+        loaded, rows = j.load_pass(0, 0)
+        assert rows == 10
+        _assert_bit_identical(loaded, frame)
+        # reopen fresh (the resume path) and truncate the spill
+        j2 = durable.open_run("f" * 64, "test")
+        assert j2.completed_count() == 1
+        spill = tmp_path / ("f" * 64) / "pass_L0_P0.arrow"
+        data = spill.read_bytes()
+        spill.write_bytes(data[:len(data) // 2])
+        obs_metrics.reset()
+        assert j2.load_pass(0, 0) is None
+        assert obs_metrics.counter_value("durable.spills_rejected") == 1
+        assert j2.load_pass(0, 0) is None  # record dropped, stays dropped
+    obs_metrics.reset()
+
+
+def test_journal_refuses_foreign_fingerprint(tmp_path):
+    """A manifest recording a different run fingerprint is refused — stale
+    spills must never leak into another run's output."""
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        durable.open_run("a" * 64, "test")
+        manifest = tmp_path / ("a" * 64) / durable.MANIFEST
+        lines = manifest.read_text().splitlines()
+        header = json.loads(lines[0])
+        header["fingerprint"] = "b" * 64
+        manifest.write_text(json.dumps(header) + "\n")
+        with pytest.raises(CylonError) as ei:
+            durable.open_run("a" * 64, "test")
+        assert ei.value.code == Code.Invalid
+        assert "refusing stale spills" in ei.value.msg
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+def test_run_fingerprint_sensitivity(rng):
+    left, right = _join_inputs(rng, n=200)
+    frames = ((list(left), left), (list(right), right))
+    fp = durable.run_fingerprint("join", (1, "hash"), frames)
+    assert fp == durable.run_fingerprint("join", (1, "hash"), frames)
+    assert fp != durable.run_fingerprint("join", (2, "hash"), frames)
+    assert fp != durable.run_fingerprint("sort", (1, "hash"), frames)
+    bumped = dict(left, a=left["a"] + 1)
+    assert fp != durable.run_fingerprint(
+        "join", (1, "hash"), ((list(bumped), bumped), (list(right), right)))
+    # a result-affecting trace knob changes the fingerprint too
+    with config.knob_env(CYLON_TPU_ACCUM="wide"):
+        assert fp != durable.run_fingerprint("join", (1, "hash"), frames)
+
+
+def test_run_fingerprint_full_content_coverage():
+    """Coverage is FULL, not sampled: changing a single element at ANY
+    index of a large column (fixed-width or object) must change the
+    fingerprint — a stale journal must never serve modified inputs."""
+    n = 100_000
+    base = {"x": np.zeros(n, np.int64)}
+    fp = durable.run_fingerprint("join", (), ((["x"], base),))
+    for idx in (1, n // 3, n - 2):
+        mod = {"x": base["x"].copy()}
+        mod["x"][idx] = 1
+        assert fp != durable.run_fingerprint("join", (), ((["x"], mod),)), idx
+    # element order matters too (position-mixed fold, not a plain xor)
+    swapped = {"x": base["x"].copy()}
+    swapped["x"][0], swapped["x"][1] = 1, 0
+    mod2 = {"x": base["x"].copy()}
+    mod2["x"][0], mod2["x"][1] = 0, 1
+    assert (durable.run_fingerprint("join", (), ((["x"], swapped),))
+            != durable.run_fingerprint("join", (), ((["x"], mod2),)))
+    strs = {"s": np.array(["row%d" % i for i in range(n // 10)], object)}
+    fps = durable.run_fingerprint("join", (), ((["s"], strs),))
+    mod3 = {"s": strs["s"].copy()}
+    mod3["s"][7] = "ROW7"
+    assert fps != durable.run_fingerprint("join", (), ((["s"], mod3),))
+
+
+def test_run_fingerprint_none_vs_literal_none_string():
+    """str() coercion maps None -> "None": the element KIND must
+    disambiguate, or a null column and a column holding the literal
+    string would share a journal (stale spills served as wrong data)."""
+    a = {"c": np.array([None, "x"], object)}
+    b = {"c": np.array(["None", "x"], object)}
+    assert (durable.run_fingerprint("t", (1,), ((["c"], a),))
+            != durable.run_fingerprint("t", (1,), ((["c"], b),)))
+    # bytes vs a str equal to their repr likewise
+    c = {"c": np.array([b"x", "y"], object)}
+    d = {"c": np.array(["b'x'", "y"], object)}
+    assert (durable.run_fingerprint("t", (1,), ((["c"], c),))
+            != durable.run_fingerprint("t", (1,), ((["c"], d),)))
+
+
+@pytest.mark.fault
+def test_unusable_durable_dir_disables_journal_not_the_run(rng, tmp_path):
+    """A journal root that cannot be used (a regular file in the way)
+    disables journaling with a warning — the run itself completes."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    left, right = _join_inputs(rng, n=800)
+    base, _ = _run(left, right, passes=2)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(blocker)):
+        res, stats = _run(left, right, passes=2)
+    assert "passes_skipped" not in stats  # no journal was active
+    assert stats["parts_run"] == stats["passes"]
+    _assert_bit_identical(res, base)
+
+
+@pytest.mark.fault
+def test_journaled_overrun_never_quarantined(rng, tmp_path):
+    """QUARANTINE_AFTER=1 + a deadline overrun whose frame was already
+    journaled: the serve-from-journal path must win over quarantine —
+    rows committed to the journal are never dropped from the output."""
+    left, right = _join_inputs(rng)
+    base, _ = _run(left, right, passes=3)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path),
+                         CYLON_TPU_PASS_DEADLINE_S="1.0",
+                         CYLON_TPU_QUARANTINE_AFTER="1",
+                         CYLON_TPU_RETRY_MAX="0",
+                         CYLON_TPU_RETRY_BASE_S="0"):
+        with resilience.fault_plan("host_fetch@2=hang") as plan:
+            res, stats = _run(left, right, passes=3)
+    assert plan.fired == [("host_fetch", "hang", 2)]
+    assert "quarantined" not in stats
+    assert stats["passes_skipped"] == 1
+    _assert_bit_identical(res, base)
+
+
+# ---------------------------------------------------------------------------
+# in-process resume (same engine path a fresh process takes)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_journal_resume_skips_completed_passes(rng, tmp_path):
+    left, right = _join_inputs(rng)
+    base, base_stats = _run(left, right)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        r1, s1 = _run(left, right)
+        obs_metrics.reset()
+        r2, s2 = _run(left, right)
+    assert s1["passes_skipped"] == 0
+    assert s2["passes_skipped"] == s2["passes"] == base_stats["passes"]
+    assert "parts_run" not in s2  # a fully journaled run executes nothing
+    assert obs_metrics.counter_value("durable.passes_skipped") == s2["passes"]
+    _assert_bit_identical(r1, base)
+    _assert_bit_identical(r2, base)
+    obs_metrics.reset()
+
+
+@pytest.mark.fault
+def test_resume_with_changed_input_reuses_nothing(rng, tmp_path):
+    """Changing ONE input value changes the run fingerprint: the journal
+    of the old run must not serve a single pass."""
+    left, right = _join_inputs(rng)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        _run(left, right)
+        left2 = dict(left, a=left["a"] + np.float32(1))
+        _, s2 = _run(left2, right)
+    assert s2["passes_skipped"] == 0
+    assert s2["parts_run"] == s2["passes"]
+
+
+@pytest.mark.fault
+def test_corrupted_spill_reexecutes_only_that_pass(rng, tmp_path):
+    """journal_corrupt fault kind: the spill committed for one pass is
+    truncated mid-run; the resume rejects exactly that pass's record and
+    re-executes it while still skipping every intact pass."""
+    left, right = _join_inputs(rng)
+    base, _ = _run(left, right)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        with resilience.fault_plan("journal_commit@2=journal_corrupt") as p:
+            r1, s1 = _run(left, right)
+        assert p.fired == [("journal_commit", "journal_corrupt", 2)]
+        obs_metrics.reset()
+        r2, s2 = _run(left, right)
+    assert s1["passes_skipped"] == 0
+    assert s2["passes_skipped"] == s2["passes"] - 1
+    assert s2["parts_run"] == 1
+    assert obs_metrics.counter_value("durable.spills_rejected") == 1
+    _assert_bit_identical(r1, base)
+    _assert_bit_identical(r2, base)
+    obs_metrics.reset()
+
+
+@pytest.mark.fault
+def test_groupby_and_sort_runs_journal_too(rng, tmp_path):
+    n = 2000
+    data = {"g": rng.integers(0, 50, n).astype(np.int64),
+            "v": rng.random(n).astype(np.float32)}
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        g1, gs1 = chunked_groupby(data, "g", {"v": ["sum"]}, passes=3)
+        g2, gs2 = chunked_groupby(data, "g", {"v": ["sum"]}, passes=3)
+        s1, ss1 = chunked_sort(data, "v", passes=3)
+        s2, ss2 = chunked_sort(data, "v", passes=3)
+    assert gs1.get("passes_skipped") == 0
+    assert gs2["passes_skipped"] == gs2["passes"]
+    assert ss1.get("passes_skipped") == 0
+    assert ss2["passes_skipped"] == ss2["passes"]
+    _assert_bit_identical(g2, g1)
+    _assert_bit_identical(s2, s1)
+
+
+# ---------------------------------------------------------------------------
+# cross-process crash-resume (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def _worker_env(tmp_path, **knobs):
+    env = dict(os.environ)
+    env.pop("CYLON_TPU_FAULT_PLAN", None)
+    env["CYLON_TPU_DURABLE_DIR"] = str(tmp_path / "journal")
+    env.update({k: v for k, v in knobs.items() if v is not None})
+    return env
+
+
+def _invoke_worker(tmp_path, tag, env):
+    out = tmp_path / f"{tag}.npz"
+    stats = tmp_path / f"{tag}.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tests.durable_worker", str(out), str(stats)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    return proc, out, stats
+
+
+@pytest.mark.fault
+def test_killhard_crash_then_fresh_process_resumes_bit_identical(
+        rng, tmp_path):
+    """kill -9 mid-journal (os._exit inside the spill/manifest window),
+    then a FRESH process re-invokes the identical run: it must complete
+    from the journal, re-execute ONLY the unfinished parts, and produce
+    bit-identical output to an uninterrupted run."""
+    from tests import durable_worker
+
+    # the uninterrupted golden, computed in-process on the worker's
+    # deterministic inputs (same engine path, no journal)
+    left, right = durable_worker.inputs(7)
+    base, base_stats = chunked_join_groupby_tables(
+        left, right, on="k", how="inner", group_by="l_k",
+        agg={"a": ["sum"], "b": ["mean"]},
+        passes=durable_worker.N_PASSES, mode="hash")
+
+    killed, _, _ = _invoke_worker(
+        tmp_path, "killed",
+        _worker_env(tmp_path,
+                    CYLON_TPU_FAULT_PLAN="journal_commit@3=killhard"))
+    assert killed.returncode == 137, (killed.returncode, killed.stderr[-2000:])
+
+    resumed, out, stats_path = _invoke_worker(
+        tmp_path, "resumed", _worker_env(tmp_path))
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    stats = json.loads(stats_path.read_text())
+    # 2 passes were committed before the kill (the 3rd died mid-commit):
+    # the fresh process must skip exactly those and run only the rest
+    assert stats["passes_skipped"] == 2
+    assert stats["parts_run"] == base_stats["passes"] - 2
+
+    got = dict(np.load(out, allow_pickle=True))
+    order = np.argsort(base["l_k"], kind="stable")
+    expected = {k: np.asarray(v)[order] for k, v in base.items()}
+    _assert_bit_identical(got, expected)
+
+
+# ---------------------------------------------------------------------------
+# pass deadlines -> Code.Timeout
+# ---------------------------------------------------------------------------
+
+def test_pass_deadline_classifies_timeout():
+    obs_metrics.reset()
+    with config.knob_env(CYLON_TPU_PASS_DEADLINE_S="0.02"):
+        dl = durable.pass_deadline("unit")
+        with dl:
+            time.sleep(0.06)
+        # the raise is decoupled from __exit__ so callers can journal a
+        # late-but-complete frame before classifying the overrun
+        with pytest.raises(CylonError) as ei:
+            dl.raise_if_fired()
+    assert ei.value.code == Code.Timeout
+    assert "CYLON_TPU_PASS_DEADLINE_S" in ei.value.msg
+    assert obs_metrics.counter_value("deadline.fired") == 1
+    obs_metrics.reset()
+
+
+@pytest.mark.fault
+def test_deadline_overrun_classified_timeout_served_from_journal(
+        rng, tmp_path):
+    """With a journal, a deadline overrun classifies as Code.Timeout
+    AFTER the late frame is journaled — the retry loads it from the
+    journal instead of re-executing an identically-slow pass forever."""
+    left, right = _join_inputs(rng)
+    base, _ = _run(left, right, passes=3)
+    obs_spans.reset()
+    obs_metrics.reset()
+    try:
+        # RETRY_MAX=0 proves the served-from-journal path consumes no
+        # retry budget: the overrun is classified Code.Timeout yet the
+        # run cannot die of it, because the result is already durable
+        with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path),
+                             CYLON_TPU_PASS_DEADLINE_S="1.0",
+                             CYLON_TPU_RETRY_MAX="0",
+                             CYLON_TPU_RETRY_BASE_S="0",
+                             CYLON_TPU_TRACE="1"):
+            with resilience.fault_plan("host_fetch@2=hang") as plan:
+                res, stats = _run(left, right, passes=3)
+        assert plan.fired == [("host_fetch", "hang", 2)]
+        assert "retries" not in stats  # no budget spent
+        served = [e for e in obs_spans.events()
+                  if e.name == "exec.pass_served_from_journal"]
+        assert [e.attrs["code"] for e in served] == ["Timeout"]
+        assert obs_metrics.counter_value("deadline.fired") == 1
+        # the overrun pass completed, was journaled, and the stream
+        # served the journaled frame — no second execution
+        assert stats["passes_skipped"] == 1
+        assert stats["parts_run"] == stats["passes"] - 1
+        _assert_bit_identical(res, base)
+    finally:
+        obs_spans.reset()
+        obs_metrics.reset()
+
+
+def test_pass_deadline_disabled_is_free():
+    with config.knob_env(CYLON_TPU_PASS_DEADLINE_S=None):
+        cm = durable.pass_deadline()
+        assert cm is durable.pass_deadline()  # shared no-op singleton
+        with cm:
+            pass
+
+
+def test_pass_deadline_prefers_inflight_exception():
+    """An exception raised inside the block wins over the deadline: its
+    classification is more specific than 'late'."""
+    with config.knob_env(CYLON_TPU_PASS_DEADLINE_S="0.01"):
+        with pytest.raises(ValueError):
+            with durable.pass_deadline("unit"):
+                time.sleep(0.03)
+                raise ValueError("the real failure")
+
+
+@pytest.mark.fault
+def test_engine_deadline_without_journal_accepts_late_result(rng):
+    """Without a journal to serve a retry from, a late-but-complete pass
+    is KEPT (deadline.accepted_late) instead of discarded — discarding
+    would condemn every consistently-slow pass to retry-until-fatal."""
+    left, right = _join_inputs(rng)
+    base, _ = _run(left, right, passes=3)
+    obs_metrics.reset()
+    try:
+        # the deadline must sit far above a real pass's cost (first passes
+        # pay host slicing + dispatch, ~hundreds of ms on a loaded CI box)
+        # while the `hang` kind sleeps 1.5x past it deterministically
+        with config.knob_env(CYLON_TPU_PASS_DEADLINE_S="1.0",
+                             CYLON_TPU_RETRY_BASE_S="0"):
+            with resilience.fault_plan("host_fetch@2=hang") as plan:
+                res, stats = _run(left, right, passes=3)
+        assert plan.fired == [("host_fetch", "hang", 2)]
+        assert "retries" not in stats  # no retry: the late frame is kept
+        assert stats["parts_run"] == stats["passes"]
+        assert obs_metrics.counter_value("deadline.fired") == 1
+        assert obs_metrics.counter_value("deadline.accepted_late") == 1
+        _assert_bit_identical(res, base)
+    finally:
+        obs_metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# poison-pass quarantine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_quarantine_report_contract(rng):
+    """A part failing the same way N consecutive times is isolated into
+    stats["quarantined"] (part, level, code, failures, msg) and the rest
+    of the stream completes — instead of exhausting retries fatally."""
+    left, right = _join_inputs(rng)
+    base, _ = _run(left, right, passes=3)
+    obs_metrics.reset()
+    with config.knob_env(CYLON_TPU_QUARANTINE_AFTER="2",
+                         CYLON_TPU_RETRY_BASE_S="0"):
+        with resilience.fault_plan("host_fetch@1=comm;host_fetch@2=comm"):
+            res, stats = _run(left, right, passes=3)
+    q = stats["quarantined"]
+    assert len(q) == 1
+    assert q[0]["part"] == 0 and q[0]["level"] == 0
+    assert q[0]["code"] == "ExecutionError" and q[0]["failures"] == 2
+    assert "connection reset" in q[0]["msg"]
+    assert stats["parts_run"] == 2
+    assert obs_metrics.counter_value("quarantine.parts") == 1
+    # the surviving parts' rows are exact; the poisoned part's are absent
+    assert 0 < len(res["l_k"]) < len(base["l_k"])
+    assert set(res["l_k"].tolist()) < set(base["l_k"].tolist())
+    obs_metrics.reset()
+
+
+@pytest.mark.fault
+def test_quarantine_never_swallows_bugs(rng):
+    """Unknown-classified failures (a TypeError, an INTERNAL error) stay
+    fatal no matter how often they repeat — quarantine is for recoverable
+    codes only."""
+    left, right = _join_inputs(rng, n=500)
+    with config.knob_env(CYLON_TPU_QUARANTINE_AFTER="1",
+                         CYLON_TPU_RETRY_BASE_S="0"):
+        with resilience.fault_plan("host_fetch@1+=unknown"):
+            with pytest.raises(Exception) as ei:
+                _run(left, right, passes=2)
+    assert resilience.classify(ei.value) == Code.UnknownError
+
+
+@pytest.mark.fault
+def test_quarantine_fires_at_retry_exhaustion_for_large_n(rng):
+    """CYLON_TPU_QUARANTINE_AFTER larger than the retry budget still
+    quarantines: a failure that would otherwise be fatal (retries
+    exhausted) isolates the part instead of killing the run."""
+    left, right = _join_inputs(rng)
+    with config.knob_env(CYLON_TPU_QUARANTINE_AFTER="10",
+                         CYLON_TPU_RETRY_MAX="1",
+                         CYLON_TPU_RETRY_BASE_S="0"):
+        with resilience.fault_plan("host_fetch@1=comm;host_fetch@2=comm"):
+            res, stats = _run(left, right, passes=3)
+    q = stats["quarantined"]
+    assert len(q) == 1 and q[0]["part"] == 0
+    assert "retries exhausted" in q[0]["msg"]
+    assert stats["parts_run"] == 2
+    assert len(res["l_k"]) > 0
+
+
+def test_frame_ipc_mixed_object_column_refuses():
+    """A non-uniform object column (f64 after f32, i64 after i32) must
+    REFUSE to serialize — silent numpy casting would corrupt the spill
+    and the checksum would bless it."""
+    for bad in ([np.float32(1.5), None, np.float64(2.5)],
+                [np.int32(1), np.int64(2), None]):
+        with pytest.raises(CylonError) as ei:
+            arrow_io.frame_to_ipc_bytes({"x": np.array(bad, object)})
+        assert ei.value.code == Code.SerializationError
+
+
+def test_spill_error_disables_journal_not_the_run(tmp_path):
+    """A frame the spiller refuses (mixed-dtype object column) disables
+    journaling for the run — counted, warned, record_pass returns False
+    — but never raises: durability is best-effort."""
+    obs_metrics.reset()
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        j = durable.open_run("c" * 64, "test")
+        mixed = {"x": np.array([np.float32(1.5), np.float64(2.5), None],
+                               object)}
+        assert j.record_pass(0, 0, mixed, 3) is False
+        assert obs_metrics.counter_value("durable.spill_errors") == 1
+        assert j.load_pass(0, 0) is None
+        # journaling stays off for the rest of the run — even good frames
+        good = {"x": np.arange(3, dtype=np.int64)}
+        assert j.record_pass(0, 1, good, 3) is False
+        assert j.load_pass(0, 1) is None
+    obs_metrics.reset()
+
+
+@pytest.mark.fault
+def test_quarantine_disabled_by_default(rng):
+    """With the knob unset (default 0) the PR-1 fail-fast contract is
+    unchanged: exhausted retries raise."""
+    left, right = _join_inputs(rng, n=500)
+    with config.knob_env(CYLON_TPU_RETRY_MAX="1",
+                         CYLON_TPU_RETRY_BASE_S="0"):
+        with resilience.fault_plan("host_fetch@1+=comm"):
+            with pytest.raises(CylonError) as ei:
+                _run(left, right, passes=2)
+    assert ei.value.code == Code.ExecutionError
+    assert "retries exhausted" in ei.value.msg

@@ -185,3 +185,59 @@ def test_faulted_run_emits_obs_events(rng):
     finally:
         obs_spans.reset()
         obs_metrics.reset()
+
+
+@pytest.mark.fault
+def test_faulted_resume_emits_durable_obs_events(rng, tmp_path):
+    """ISSUE-5: a run that dies fatally mid-stream with a durable journal
+    active, re-invoked in the same (or a fresh) process, must show the
+    resume in the event stream — durable.resume on journal open,
+    durable.pass_skipped per served part, matching durable.passes_skipped
+    counter, and parts_run covering only the re-executed tail."""
+    from cylon_tpu import config, resilience
+    from cylon_tpu.obs import metrics as obs_metrics
+    from cylon_tpu.obs import spans as obs_spans
+
+    n = 20_000
+    lk = rng.integers(0, n, n).astype(np.int32)
+    lv = rng.random(n).astype(np.float32)
+    rk = rng.integers(0, n, n).astype(np.int32)
+    rv = rng.random(n).astype(np.float32)
+    base, base_stats = chunked_join_groupby(lk, lv, rk, rv, 4)
+    obs_spans.reset()
+    obs_metrics.reset()
+    try:
+        with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path),
+                             CYLON_TPU_RETRY_MAX="0",
+                             CYLON_TPU_TRACE="1"):
+            # run 1 journals its first pass, then dies of a persistent
+            # transient with the retry budget at zero
+            with resilience.fault_plan("host_fetch@2+=comm"):
+                with pytest.raises(Exception):
+                    chunked_join_groupby(lk, lv, rk, rv, 4)
+            obs_spans.reset()
+            obs_metrics.reset()
+            res, stats = chunked_join_groupby(lk, lv, rk, rv, 4)
+        assert stats["passes_skipped"] == 1
+        assert stats["parts_run"] == base_stats["passes"] - 1
+        by_name = {}
+        for e in obs_spans.events():
+            by_name.setdefault(e.name, []).append(e)
+        assert len(by_name["durable.resume"]) == 1
+        assert by_name["durable.resume"][0].attrs["journaled_passes"] == 1
+        skipped = by_name["durable.pass_skipped"]
+        assert [e.attrs["part"] for e in skipped] == [0]
+        assert skipped[0].attrs["rows"] >= 0
+        counters = obs_metrics.snapshot()["counters"]
+        assert counters["durable.passes_skipped"] == 1
+        assert counters["durable.resumes"] == 1
+        assert counters["exec.parts_run"] == stats["parts_run"]
+        # and the resumed result matches the uninterrupted golden exactly
+        order = np.argsort(res["key"], kind="stable")
+        border = np.argsort(base["key"], kind="stable")
+        for k in base:
+            np.testing.assert_array_equal(res[k][order], base[k][border],
+                                          err_msg=k)
+    finally:
+        obs_spans.reset()
+        obs_metrics.reset()

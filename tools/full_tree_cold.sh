@@ -67,6 +67,47 @@ if [ $rc -ne 0 ]; then
   echo "trace smoke failed (rc=$rc); fix obs wiring before the full tree" >&2
   exit $rc
 fi
+# crash-resume smoke (ISSUE-5): a journaled run killed hard (os._exit at
+# the manifest-commit fault point) must resume bit-identically from a
+# fresh process, re-executing only the unfinished passes — catches a
+# durable-execution regression in ~30 s, before the full tree runs
+DJ=$(mktemp -d /tmp/cylon_durable_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_DURABLE_DIR="$DJ/journal" \
+    CYLON_TPU_FAULT_PLAN='journal_commit@2=killhard' \
+    python -m tests.durable_worker "$DJ/killed.npz" "$DJ/killed.json" \
+    >/dev/null 2>&1
+krc=$?
+if [ $krc -ne 137 ]; then
+  echo "crash-resume smoke: killhard run exited $krc (expected 137)" >&2
+  rm -rf "$DJ"; exit 1
+fi
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_DURABLE_DIR="$DJ/journal" \
+    python -m tests.durable_worker "$DJ/resumed.npz" "$DJ/resumed.json" \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m tests.durable_worker "$DJ/base.npz" "$DJ/base.json" \
+  && python - "$DJ" <<'PYEOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+stats = json.load(open(f"{d}/resumed.json"))
+assert stats["passes_skipped"] == 1, stats   # 1 pass committed pre-kill
+assert stats["parts_run"] == stats["passes"] - 1, stats
+r = np.load(f"{d}/resumed.npz"); b = np.load(f"{d}/base.npz")
+assert set(r.files) == set(b.files)
+for f in b.files:
+    assert r[f].dtype == b[f].dtype, f
+    np.testing.assert_array_equal(r[f], b[f], err_msg=f)
+print(f"crash-resume smoke ok: skipped {stats['passes_skipped']}, "
+      f"re-ran {stats['parts_run']} of {stats['passes']} passes")
+PYEOF
+rc=$?
+rm -rf "$DJ"
+if [ $rc -ne 0 ]; then
+  echo "crash-resume smoke failed (rc=$rc); fix durable journaling before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

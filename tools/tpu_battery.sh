@@ -80,9 +80,23 @@ rc=$?
 log "bench chunked (12 passes) rc=$rc $(head -c 200 "$OUT/bench_chunked.json" 2>/dev/null)"
 # success means a measurement AT THE TARGET SIZE: on OOM bench.py steps
 # down a size and still emits a clean JSON, which must not mask the
-# 1B-row miss (the artifact line carries rows_per_side)
-if ! grep -q '"rows_per_side": 536870912' "$OUT/bench_chunked.json" 2>/dev/null || \
-   grep -q '"error"' "$OUT/bench_chunked.json" 2>/dev/null; then
+# 1B-row miss.  PARSE the artifact (a substring grep would silently
+# re-run — doubling the 5000 s step — the moment JSON formatting or key
+# order changed): success iff rows_per_side == 2^29 and no error key.
+chunked_at_target() {
+  python - "$1" <<'PY'
+import json, sys
+try:
+    with open(sys.argv[1]) as fh:
+        doc = json.load(fh)
+except (OSError, ValueError):
+    sys.exit(1)
+ok = (isinstance(doc, dict) and "error" not in doc
+      and doc.get("rows_per_side") == 536870912)
+sys.exit(0 if ok else 1)
+PY
+}
+if ! chunked_at_target "$OUT/bench_chunked.json"; then
   log "3b/9 retry chunked at 16 passes"
   CYLON_BENCH_ROWS=536870912,268435456 CYLON_BENCH_PASSES=16 \
       CYLON_BENCH_BUDGET_S=5000 timeout 5100 python bench.py \

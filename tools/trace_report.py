@@ -136,6 +136,19 @@ def print_report(trace_path: str, metrics_path: "str | None",
               f"{c.get('plan_cache.hit', 0)}/{c.get('plan_cache.miss', 0)}")
         print(f"  retries / oom refinements  "
               f"{c.get('retry.attempts', 0)}/{c.get('oom.refinements', 0)}")
+        if any(k.startswith(("durable.", "deadline.", "quarantine."))
+               for k in c):
+            # durable-execution summary: how much of the run was served
+            # from the journal vs re-executed, and why
+            print(f"  journaled / skipped passes "
+                  f"{int(c.get('durable.passes_journaled', 0))}/"
+                  f"{int(c.get('durable.passes_skipped', 0))}")
+            print(f"  spill bytes / rejected     "
+                  f"{int(c.get('durable.spill_bytes', 0))}/"
+                  f"{int(c.get('durable.spills_rejected', 0))}")
+            print(f"  deadlines / quarantined    "
+                  f"{int(c.get('deadline.fired', 0))}/"
+                  f"{int(c.get('quarantine.parts', 0))}")
         g = m.get("gauges", {})
         if "hbm.live_bytes" in g:
             print(f"  hbm watermark bytes        "
