@@ -31,7 +31,8 @@ from . import dtypes
 from . import io
 from .column import Column
 from .config import JoinAlgorithm, JoinConfig, JoinType, SortOptions
-from .context import CommType, CylonContext, LocalConfig, TPUConfig
+from .context import (CommType, CylonContext, ElasticConfig, LocalConfig,
+                      TPUConfig)
 from .frame import DataFrame
 from .index import (CategoricalIndex, ColumnIndex, Index, Int64Index,
                     IntegerIndex, NumericIndex, RangeIndex)
@@ -44,7 +45,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Table", "DataFrame", "Series", "Column", "CylonContext", "TPUConfig",
-    "LocalConfig", "CommType", "JoinConfig", "JoinType", "JoinAlgorithm",
+    "ElasticConfig", "LocalConfig", "CommType", "JoinConfig", "JoinType",
+    "JoinAlgorithm",
     "SortOptions", "AggOp", "Status", "Code", "CylonError", "dtypes", "io",
     "compute", "Index", "RangeIndex", "NumericIndex", "IntegerIndex",
     "Int64Index", "CategoricalIndex", "ColumnIndex", "__version__",

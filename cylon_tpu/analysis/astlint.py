@@ -35,6 +35,8 @@ RULES: Dict[str, str] = {
     "CY103": "trace-time knob missing from a jit-plan cache key",
     "CY104": "retry wrapper lexically enclosing a collective",
     "CY105": "swallowed exception classification",
+    "CY106": "collective reachable from an elastic recovery path without "
+             "an epoch guard",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
 }
@@ -49,6 +51,15 @@ COLLECTIVE_NAMES = frozenset({
     "allreduce_sum", "allreduce_min", "allreduce_max", "psum",
     "ppermute", "collective_permute", "pmax", "pmin",
 })
+
+#: the elastic control-plane module and its recovery entry points (any
+#: function there named elastic_*), for CY106 reachability
+ELASTIC_MODULE = "cylon_tpu.elastic"
+ELASTIC_ROOT_PREFIX = "elastic_"
+
+#: calls that count as an epoch guard on a recovery path: the agent's
+#: membership check, or an engine-level guard hook
+EPOCH_GUARD_NAMES = frozenset({"ensure_epoch", "epoch_guard"})
 
 _SUPPRESS_RE = re.compile(
     r"#\s*cylint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--\s*(\S.*))?\s*$")
@@ -741,6 +752,43 @@ def _names_bound_to_knobs(mod: _Module) -> Dict[str, Set[str]]:
     return out
 
 
+def _check_elastic_guards(prog: _Program, mod: _Module) -> None:
+    """CY106: an elastic recovery entry point (``cylon_tpu.elastic``
+    function named ``elastic_*``) from which a collective is reachable
+    must also reach an epoch guard (``ensure_epoch``/``epoch_guard``).
+
+    The invariant behind it: after a membership change, re-issuing a
+    collective derived from the OLD world desyncs whoever survived —
+    the PR-1 no-retry rule generalized to recovery control flow.  The
+    check is reachability-level, not path-sensitive: a guard anywhere
+    under the root satisfies it (the guard hook runs per pass, so
+    lexical placement inside the loop is the engine's contract)."""
+    if mod.name != ELASTIC_MODULE:
+        return
+    for f in mod.funcs.values():
+        name = f.qual.rsplit(".", 1)[-1]
+        if not name.startswith(ELASTIC_ROOT_PREFIX):
+            continue
+        colls = prog.collective_reach(f)
+        if not colls:
+            continue
+        guards: Set[str] = set()
+        for q in prog.reachable(f):
+            fn = prog.by_qual.get(q)
+            if fn is not None:
+                guards |= fn.call_finals & EPOCH_GUARD_NAMES
+        if not guards:
+            mod.findings.append(Finding(
+                "CY106", mod.path, f.lineno,
+                f"elastic recovery path `{name}` reaches collective(s) "
+                f"{', '.join(sorted(colls))} with no epoch guard — after "
+                f"a membership change the collective would be issued "
+                f"against the old world and desync the survivors",
+                "call agent.ensure_epoch(epoch) (or install it as the "
+                "engine's pass_guard) before dispatching work on the "
+                "recovery path"))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -774,6 +822,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_excepts(mod)
         _check_retries(prog, mod)
         _check_plan_keys(prog, mod)
+        _check_elastic_guards(prog, mod)
         for f in mod.funcs.values():
             if f.qual in traced:
                 _Taint(f, mod, mod.findings).run()

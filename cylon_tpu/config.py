@@ -261,6 +261,27 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "into the run report (stats['quarantined']) instead of "
             "wedging retries/refinement forever.  0 (default) disables "
             "(PR-1 fail-fast behavior)."),
+    _K("CYLON_TPU_ELASTIC", "bool", False, RUNTIME,
+       accessors=("cylon_tpu.elastic.elastic_enabled",),
+       help="Env-driven elastic opt-in: with this set (and "
+            "CYLON_TPU_ELASTIC_COORD pointing at the coordinator) every "
+            "distributed CylonContext joins the membership gang at its "
+            "process id — the deployment path where hosts only get env "
+            "vars.  ElasticConfig contexts join explicitly regardless.  "
+            "Off (default) preserves the fixed-world behavior."),
+    _K("CYLON_TPU_ELASTIC_COORD", "str", "", RUNTIME,
+       accessors=("cylon_tpu.elastic.coordinator_address",),
+       help="Elastic coordinator address (host:port) agents join; empty "
+            "means no coordinator is configured (elastic contexts refuse "
+            "to start)."),
+    _K("CYLON_TPU_HEARTBEAT_S", "float", 0.5, RUNTIME,
+       accessors=("cylon_tpu.elastic.heartbeat_interval",),
+       help="Elastic agent heartbeat cadence in seconds (also the "
+            "rendezvous-barrier poll interval)."),
+    _K("CYLON_TPU_HEARTBEAT_TIMEOUT_S", "float", 2.5, RUNTIME,
+       accessors=("cylon_tpu.elastic.heartbeat_timeout",),
+       help="Silence window after which the coordinator declares a rank "
+            "dead and bumps the membership epoch (shrink-and-resume)."),
     _K("CYLON_TPU_DEBUG", "bool", False, RUNTIME,
        help="Log every span's duration at INFO (cylon_tpu.obs.spans; the "
             "utils.timing shim's historical switch)."),

@@ -78,6 +78,28 @@ class TPUConfig(CommConfig):
         return CommType.TPU
 
 
+class ElasticConfig(TPUConfig):
+    """Config for one member of an ELASTIC gang (PR 6): each process
+    drives its own local mesh while a TCP control plane
+    (``cylon_tpu.elastic``: coordinator + per-process agent, heartbeats,
+    epoch-numbered membership) tracks who is alive.  On a membership
+    change the gang re-forms at the shrunken world — re-init rather than
+    reshape, because XLA cannot reshape a live mesh — and the durable
+    journal carries completed work across the shrink.
+
+    ``coordinator``: ``host:port`` of the running `elastic.Coordinator`
+    (default: the ``CYLON_TPU_ELASTIC_COORD`` knob); ``rank``: this
+    process's gang rank.  ``devices``/``world_size`` configure the LOCAL
+    mesh exactly as on `TPUConfig`.
+    """
+
+    def __init__(self, rank: int, coordinator: Optional[str] = None,
+                 devices=None, world_size: Optional[int] = None):
+        super().__init__(devices=devices, world_size=world_size)
+        self.rank = int(rank)
+        self.coordinator = coordinator
+
+
 class CylonContext:
     """Entry point holding the mesh, config map and sequence counter.
 
@@ -123,6 +145,27 @@ class CylonContext:
         from jax.sharding import Mesh
 
         self.mesh = Mesh(self.devices, (PARTITION_AXIS,))
+        self._elastic_agent = None
+        if isinstance(config, ElasticConfig):
+            # join the gang AFTER the local mesh exists: membership is a
+            # control-plane fact layered over per-process meshes (the
+            # gang re-forms, the mesh never reshapes)
+            from . import elastic
+
+            self._elastic_agent = elastic.connect(config.rank,
+                                                  config.coordinator)
+        elif self.distributed and isinstance(config, TPUConfig):
+            # env-driven opt-in (CYLON_TPU_ELASTIC=1 + _ELASTIC_COORD):
+            # a plain distributed context joins the gang without code
+            # changes — the deployment path where each host only gets
+            # environment variables.  The gang rank is the process id
+            # (single-process-per-host contexts default to rank 0).
+            from . import elastic
+
+            if elastic.elastic_enabled():
+                rank = (config.process_id
+                        if config.process_id is not None else 0)
+                self._elastic_agent = elastic.connect(rank)
 
     # -- reference-parity static factories (ctx/cylon_context.cpp:25-43) ----
     @staticmethod
@@ -138,9 +181,16 @@ class CylonContext:
     # -- identity ----------------------------------------------------------
     def GetRank(self) -> int:
         # process-level rank (multi-host); mesh positions are the data ranks
+        if self._elastic_agent is not None:
+            return self._elastic_agent.rank
         import jax
 
         return jax.process_index() if self.distributed else 0
+
+    def elastic_agent(self):
+        """The `elastic.Agent` this context joined the gang with, or
+        None for fixed-world contexts."""
+        return self._elastic_agent
 
     def GetWorldSize(self) -> int:
         return int(self.devices.size) if self.distributed else 1
@@ -150,6 +200,11 @@ class CylonContext:
         return self.GetWorldSize()
 
     def GetNeighbours(self, include_self: bool = False) -> List[int]:
+        # elastic contexts: neighbours are the LIVE gang members (the
+        # mesh world size is per-process and says nothing about peers)
+        if self._elastic_agent is not None:
+            return [m for m in self._elastic_agent.members
+                    if include_self or m != self._elastic_agent.rank]
         return [i for i in range(self.GetWorldSize())
                 if include_self or i != self.GetRank()]
 
@@ -233,6 +288,8 @@ class CylonContext:
 
     def Finalize(self) -> None:
         self._finalized = True
+        if self._elastic_agent is not None:
+            self._elastic_agent.leave()
 
     def __repr__(self) -> str:
         kind = "distributed" if self.distributed else "local"

@@ -212,10 +212,20 @@ class RunJournal:
     that would silently corrupt a resumed run (garbage fails the
     checksum and is re-executed too)."""
 
-    def __init__(self, root: str, fingerprint: str, op: str):
+    def __init__(self, root: str, fingerprint: str, op: str,
+                 world: Optional[int] = None, epoch: Optional[int] = None):
         self.fingerprint = fingerprint
         self.op = op
         self.dir = os.path.join(root, fingerprint)
+        # elastic provenance (PR 6): the membership world size and epoch
+        # this PROCESS is journaling under.  Part ids are global
+        # positions in the key-domain plan — world-INDEPENDENT — so the
+        # fingerprint deliberately excludes world/epoch (a shard
+        # journaled at world W must be consumed, not refused, at world
+        # W-1); world/epoch ride the manifest as per-pass provenance so
+        # the shrink history is auditable after the fact.
+        self.world = world
+        self.epoch = epoch
         self._passes: Dict[Tuple[int, int], dict] = {}
         self._quarantined: List[dict] = []
         self._last_committed: Optional[str] = None
@@ -224,7 +234,9 @@ class RunJournal:
     # -- open / manifest replay -----------------------------------------
 
     @classmethod
-    def open_run(cls, fingerprint: str, op: str) -> Optional["RunJournal"]:
+    def open_run(cls, fingerprint: str, op: str,
+                 world: Optional[int] = None,
+                 epoch: Optional[int] = None) -> Optional["RunJournal"]:
         """Open (creating if needed) the journal for ``fingerprint``, or
         None when durability is disabled — or when the journal root is
         unusable (unwritable, not a directory, IO errors): best-effort
@@ -236,7 +248,7 @@ class RunJournal:
         root = durable_dir()
         if not root:
             return None
-        j = cls(root, fingerprint, op)
+        j = cls(root, fingerprint, op, world=world, epoch=epoch)
         try:
             j._open()
         except OSError as e:
@@ -278,10 +290,14 @@ class RunJournal:
                 f"{header.get('fingerprint')!r} != this run's "
                 f"{self.fingerprint!r}: refusing stale spills")
         if header is None:
+            entry = {"kind": "run", "fingerprint": self.fingerprint,
+                     "op": self.op}
+            if self.world is not None:
+                entry["world"] = int(self.world)
+            if self.epoch is not None:
+                entry["epoch"] = int(self.epoch)
             try:
-                self._append({"kind": "run",
-                              "fingerprint": self.fingerprint,
-                              "op": self.op})
+                self._append(entry)
             except OSError as e:
                 # journaling is best-effort: an unwritable journal must
                 # never fail the run it was meant to protect — loads (the
@@ -357,6 +373,10 @@ class RunJournal:
             entry = {"kind": "pass", "level": int(level), "part": int(part),
                      "rows": int(rows), "file": name, "sha256": digest,
                      "bytes": len(payload)}
+            if self.world is not None:
+                entry["world"] = int(self.world)
+            if self.epoch is not None:
+                entry["epoch"] = int(self.epoch)
             try:
                 self._append(entry)
             except OSError as e:
@@ -424,9 +444,10 @@ class RunJournal:
             log.warning("durable: quarantine record failed: %s", e)
 
 
-def open_run(fingerprint: str, op: str) -> Optional[RunJournal]:
+def open_run(fingerprint: str, op: str, world: Optional[int] = None,
+             epoch: Optional[int] = None) -> Optional[RunJournal]:
     """Module-level convenience over :meth:`RunJournal.open_run`."""
-    return RunJournal.open_run(fingerprint, op)
+    return RunJournal.open_run(fingerprint, op, world=world, epoch=epoch)
 
 
 def _corrupt_last_spill() -> None:

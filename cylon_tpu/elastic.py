@@ -1,0 +1,734 @@
+"""Elastic multi-process membership: epochs, failure detection, and
+journal-backed shrink-and-resume.
+
+The reference's only failure story is gang restart: ``mpirun`` tears the
+whole world down and re-runs from source data (PAPER.md §5).  PR 5's
+durable journal already made one process's death cost at most one pass;
+this module supplies the missing CONTROL PLANE so a *cluster* of
+processes survives losing a member:
+
+- one **Coordinator** (TCP, ``net/control.py`` one-shot JSON requests)
+  owns the membership ledger: which ranks are alive, and the **epoch** —
+  a counter bumped on every membership change.  Failure detection is
+  heartbeat-based (``CYLON_TPU_HEARTBEAT_S`` cadence, declared dead
+  after ``CYLON_TPU_HEARTBEAT_TIMEOUT_S`` of silence) plus explicit
+  reports (an agent classifying a collective failure via `Status` can
+  indict a peer);
+- one **Agent** per process heartbeats, mirrors the coordinator's view,
+  and exposes :meth:`Agent.ensure_epoch` — the guard the streaming
+  engine calls between passes so in-flight work is ABANDONED the moment
+  membership changes (`EpochChanged`), never retried into a desynced
+  world;
+- a **rendezvous barrier** (polled, so heartbeats keep flowing while a
+  rank waits) that completes only when every live member of the SAME
+  epoch arrives; a straggler carrying a stale epoch — or a rank the
+  coordinator already declared dead — is rejected, not admitted into a
+  world that has moved on;
+- :func:`elastic_run` drives the shrink-and-resume loop: parts of the
+  key domain (the splitmix64 partitioning of exec.py, ``mode="hash"``)
+  are deterministically assigned to live members (``owned_parts``); on
+  `EpochChanged` the survivors re-derive the assignment over the
+  shrunken membership and re-enter — a **gang re-init**, because XLA
+  cannot reshape a live mesh — and the durable journal (extended with
+  per-pass world/epoch provenance) makes the re-entry cheap: every part
+  journaled before the failure, by ANY rank at ANY world size, is
+  consumed instead of re-executed.  Part ids are world-independent
+  (global positions in the key-domain plan), so a shard journaled at
+  world W is consumed verbatim at world W-1 — the mesh-shape-to-
+  mesh-shape redistribution argument of arxiv 2112.01075, with the
+  journal as the transfer medium.
+
+Coordinator death is NOT survivable (it is the membership ground truth,
+deliberately un-replicated): agents detect it after a few failed
+heartbeats and fail *clean* — `CoordinatorLost`, a classified `Status`
+(`Code.Unavailable`), never a hang.
+
+Everything here is host-side stdlib (sockets + threads; no jax), so the
+jaxpr collective-budget goldens are untouched by construction, and every
+recovery path runs deterministically on CPU via the resilience fault
+kinds ``rank_kill`` (``os._exit(137)`` at a pass boundary),
+``heartbeat_loss`` (the agent goes silent but keeps computing) and
+``coordinator_loss`` (the coordinator dies mid-detection) —
+tests/test_elastic.py, tests/elastic_worker.py.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import config
+from . import resilience
+from .net import control
+from .obs import metrics as obs_metrics
+from .obs import spans as obs_spans
+from .status import Code, CylonError, Status
+
+log = logging.getLogger("cylon_tpu")
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def elastic_enabled() -> bool:
+    """``CYLON_TPU_ELASTIC``: opt-in switch for elastic membership."""
+    return bool(config.knob("CYLON_TPU_ELASTIC"))
+
+
+def coordinator_address() -> str:
+    """``CYLON_TPU_ELASTIC_COORD``: coordinator ``host:port``."""
+    return str(config.knob("CYLON_TPU_ELASTIC_COORD"))
+
+
+def heartbeat_interval() -> float:
+    """``CYLON_TPU_HEARTBEAT_S``: agent heartbeat cadence (seconds)."""
+    return max(0.01, float(config.knob("CYLON_TPU_HEARTBEAT_S")))
+
+
+def heartbeat_timeout() -> float:
+    """``CYLON_TPU_HEARTBEAT_TIMEOUT_S``: silence after which a rank is
+    declared dead."""
+    return max(0.05, float(config.knob("CYLON_TPU_HEARTBEAT_TIMEOUT_S")))
+
+
+def _parse_address(addr) -> Tuple[str, int]:
+    if isinstance(addr, (tuple, list)):
+        return str(addr[0]), int(addr[1])
+    host, _, port = str(addr).rpartition(":")
+    if not host or not port:
+        raise CylonError(Code.Invalid,
+                         f"bad coordinator address {addr!r} (want host:port)")
+    return host, int(port)
+
+
+# ---------------------------------------------------------------------------
+# failures
+# ---------------------------------------------------------------------------
+
+class EpochChanged(CylonError):
+    """Membership moved (a rank died, or WE were declared dead): abandon
+    in-flight work and re-derive the assignment.  `Code.EpochMismatch`
+    is deliberately outside `RETRYABLE_CODES` — retrying the same pass
+    into a changed world is exactly the desync PR 1's no-retry-
+    collectives policy exists to prevent; the elastic loop must re-plan,
+    not re-try."""
+
+    def __init__(self, msg: str):
+        super().__init__(Code.EpochMismatch, msg)
+
+
+class CoordinatorLost(CylonError):
+    """The membership ground truth is gone: fail clean with a classified
+    `Status` (`Code.Unavailable`, non-retryable) instead of hanging on a
+    barrier no one will ever complete."""
+
+    def __init__(self, msg: str):
+        super().__init__(Code.Unavailable, msg)
+
+
+@dataclass(frozen=True)
+class MemberView:
+    """One consistent observation of the membership ledger."""
+
+    epoch: int
+    members: Tuple[int, ...]   # sorted live ranks
+    world: int                 # initial gang size (epoch-0 world)
+
+    def require_member(self, rank: int) -> None:
+        if rank not in self.members:
+            raise EpochChanged(
+                f"rank {rank} is not a member at epoch {self.epoch} "
+                f"(declared dead; members={list(self.members)})")
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+
+class Coordinator:
+    """Membership ledger + failure detector + rendezvous barriers.
+
+    One per gang (the elastic analog of ``mpirun``'s runtime daemon).
+    State transitions are shrink-only: a rank joins once (``hello``),
+    heartbeats while alive, and is moved to ``dead`` — bumping the epoch
+    — on heartbeat timeout, an explicit peer report, or a clean
+    ``leave``.  Dead ranks stay dead: a late heartbeat or barrier from
+    one is *rejected* (the straggler learns it was fenced off and must
+    not touch shared state as a member).
+    """
+
+    def __init__(self, world: int, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout_s: Optional[float] = None):
+        if world < 1:
+            raise CylonError(Code.Invalid, f"world must be >= 1, got {world}")
+        self.world = int(world)
+        self.timeout = (heartbeat_timeout() if heartbeat_timeout_s is None
+                        else max(0.05, float(heartbeat_timeout_s)))
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._last_hb: Dict[int, float] = {}     # alive ranks -> monotonic
+        self._dead: Dict[int, str] = {}          # rank -> reason
+        self._barriers: Dict[Tuple[str, int], set] = {}
+        # latched completed rendezvous, insertion-ordered dict-as-set so
+        # the bound evicts oldest-first (a slow member only ever polls a
+        # RECENTLY completed barrier)
+        self._completed_barriers: Dict[Tuple[str, int], bool] = {}
+        self._stop = threading.Event()
+        self.died = False                        # coordinator_loss fired
+        self._server = control.JsonServer(self._handle, host=host, port=port)
+        self.address: Tuple[str, int] = self._server.address
+        self._detector: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Coordinator":
+        self._server.start()
+        self._detector = threading.Thread(target=self._detect, daemon=True,
+                                          name="cylon-elastic-detector")
+        self._detector.start()
+        log.info("elastic: coordinator up at %s:%d (world=%d, "
+                 "heartbeat timeout %.2fs)", *self.address, self.world,
+                 self.timeout)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.close()
+
+    def _die(self) -> None:
+        """Simulated coordinator crash (the ``coordinator_loss`` fault
+        kind): drop the socket without ceremony — agents must detect the
+        silence and fail clean."""
+        self.died = True
+        log.warning("elastic: coordinator dying (injected coordinator_loss)")
+        self.stop()
+
+    # -- failure detector ------------------------------------------------
+
+    def _detect(self) -> None:
+        tick = max(self.timeout / 4.0, 0.02)
+        while not self._stop.wait(tick):
+            try:
+                resilience.fault_point("elastic.coordinator")
+            except resilience.InjectedFault as e:
+                if e.kind == "coordinator_loss":
+                    self._die()
+                    return
+                raise
+            now = time.monotonic()
+            with self._lock:
+                late = [r for r, hb in self._last_hb.items()
+                        if now - hb > self.timeout]
+                for rank in late:
+                    self._mark_dead_locked(rank, "heartbeat timeout")
+
+    def _mark_dead_locked(self, rank: int, reason: str) -> None:
+        if rank in self._dead or rank not in self._last_hb:
+            return
+        del self._last_hb[rank]
+        self._dead[rank] = reason
+        self._epoch += 1
+        # pending barriers from earlier epochs can never complete (their
+        # pollers get epoch_changed and re-enter at the new epoch): drop
+        # them so arrival sets don't accumulate across a long shrink
+        for key in [k for k in self._barriers if k[1] < self._epoch]:
+            del self._barriers[key]
+        obs_spans.instant("elastic.rank_lost", rank=rank, reason=reason,
+                          epoch=self._epoch)
+        obs_metrics.counter_add("elastic.rank_lost")
+        obs_metrics.gauge_set("elastic.epoch", self._epoch)
+        log.warning("elastic: rank %d declared dead (%s); epoch -> %d, "
+                    "members -> %s", rank, reason, self._epoch,
+                    sorted(self._last_hb))
+
+    # -- request handling ------------------------------------------------
+
+    def _view_locked(self) -> Dict:
+        return {"epoch": self._epoch,
+                "members": sorted(self._last_hb),
+                "world": self.world}
+
+    def view(self) -> MemberView:
+        with self._lock:
+            v = self._view_locked()
+        return MemberView(v["epoch"], tuple(v["members"]), v["world"])
+
+    def _handle(self, req: Dict) -> Dict:
+        cmd = req.get("cmd")
+        rank = req.get("rank")
+        with self._lock:
+            if cmd == "status":
+                return {"ok": True, "dead": dict(self._dead),
+                        **self._view_locked()}
+            if not isinstance(rank, int):
+                return {"ok": False, "error": f"bad rank {rank!r}"}
+            if rank in self._dead and cmd != "status":
+                # fenced: the rank was declared dead; it must stand down
+                return {"ok": False, "status": "rejected",
+                        "reason": self._dead[rank], **self._view_locked()}
+            if cmd == "hello":
+                if rank in self._last_hb:
+                    return {"ok": True, **self._view_locked()}
+                if not 0 <= rank < self.world:
+                    return {"ok": False,
+                            "error": f"rank {rank} outside world "
+                                     f"{self.world}"}
+                self._last_hb[rank] = time.monotonic()
+                log.info("elastic: rank %d joined (%d/%d)", rank,
+                         len(self._last_hb) + len(self._dead), self.world)
+                return {"ok": True, **self._view_locked()}
+            if cmd == "heartbeat":
+                if rank not in self._last_hb:
+                    return {"ok": False, "status": "rejected",
+                            "reason": "unknown rank", **self._view_locked()}
+                self._last_hb[rank] = time.monotonic()
+                return {"ok": True, **self._view_locked()}
+            if cmd == "barrier":
+                name, epoch = str(req.get("name")), req.get("epoch")
+                if (name, epoch) in self._completed_barriers:
+                    # latched: every live member of `epoch` once arrived.
+                    # Completion is monotone, so a member that finished,
+                    # got "go" and LEFT (bumping the epoch) must not
+                    # convert the others' still-pending polls into a
+                    # spurious epoch_changed resume
+                    return {"ok": True, "status": "go",
+                            **self._view_locked()}
+                if epoch != self._epoch:
+                    return {"ok": True, "status": "epoch_changed",
+                            **self._view_locked()}
+                if len(self._last_hb) + len(self._dead) < self.world:
+                    # the gang has not fully formed: a premature barrier
+                    # among the early joiners must not "go" before the
+                    # remaining ranks exist to be counted
+                    return {"ok": True, "status": "wait",
+                            **self._view_locked()}
+                arrived = self._barriers.setdefault((name, epoch), set())
+                arrived.add(rank)
+                if set(self._last_hb) <= arrived:
+                    del self._barriers[(name, epoch)]
+                    self._completed_barriers[(name, epoch)] = True
+                    while len(self._completed_barriers) > 256:
+                        self._completed_barriers.pop(
+                            next(iter(self._completed_barriers)))
+                    return {"ok": True, "status": "go",
+                            **self._view_locked()}
+                return {"ok": True, "status": "wait", **self._view_locked()}
+            if cmd == "report_failure":
+                peer = req.get("peer")
+                if isinstance(peer, int) and peer in self._last_hb:
+                    self._mark_dead_locked(
+                        peer, f"reported by rank {rank}: "
+                              f"{req.get('code', '?')}: "
+                              f"{req.get('msg', '')[:200]}")
+                return {"ok": True, **self._view_locked()}
+            if cmd == "leave":
+                if rank in self._last_hb:
+                    self._mark_dead_locked(rank, "left")
+                return {"ok": True, **self._view_locked()}
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+
+# ---------------------------------------------------------------------------
+# agent
+# ---------------------------------------------------------------------------
+
+class Agent:
+    """Per-process membership client: heartbeats on a daemon thread,
+    mirrors the coordinator's (epoch, members) view, and guards work
+    against membership drift.
+
+    Thread model: the heartbeat thread only ever *advances* the local
+    view; readers (:meth:`view`, :meth:`ensure_epoch`) take the same
+    lock, so a guard never observes a torn epoch/members pair.
+    """
+
+    #: consecutive failed round trips before the coordinator is presumed
+    #: dead — one lost packet must not fail a run
+    MAX_RPC_FAILURES = 3
+
+    def __init__(self, address, rank: int,
+                 interval_s: Optional[float] = None,
+                 timeout_s: Optional[float] = None,
+                 join_timeout_s: float = 20.0):
+        self.rank = int(rank)
+        self._addr = _parse_address(address)
+        self.interval = (heartbeat_interval() if interval_s is None
+                         else max(0.01, float(interval_s)))
+        self._rpc_timeout = (heartbeat_timeout() if timeout_s is None
+                             else max(0.05, float(timeout_s)))
+        self._join_timeout = join_timeout_s
+        self._lock = threading.Lock()
+        self._epoch = -1
+        self._members: Tuple[int, ...] = ()
+        self._world = 0
+        self._stop = threading.Event()
+        self._coord_down = False
+        self._fenced = False        # coordinator declared US dead
+        self._silenced = False      # heartbeat_loss fault: stop beating
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Agent":
+        """Join the gang (``hello``, retried while the coordinator comes
+        up) and start heartbeating."""
+        deadline = time.monotonic() + self._join_timeout
+        while True:
+            try:
+                resp = self._rpc({"cmd": "hello", "rank": self.rank})
+                break
+            except OSError as e:
+                if time.monotonic() >= deadline:
+                    raise CoordinatorLost(
+                        f"rank {self.rank}: coordinator at "
+                        f"{self._addr[0]}:{self._addr[1]} unreachable for "
+                        f"{self._join_timeout:g}s joining the gang: "
+                        f"{type(e).__name__}: {e}") from e
+                time.sleep(min(self.interval, 0.2))
+        self._absorb(resp)
+        if not resp.get("ok"):
+            raise CylonError(Code.Invalid,
+                             f"rank {self.rank}: join rejected: {resp}")
+        self._thread = threading.Thread(target=self._beat, daemon=True,
+                                        name=f"cylon-elastic-hb-r{self.rank}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop heartbeating WITHOUT telling the coordinator — process
+        death semantics (the detector will reap us).  Use :meth:`leave`
+        for a clean goodbye."""
+        self._stop.set()
+
+    def leave(self) -> None:
+        self._stop.set()
+        try:
+            self._rpc({"cmd": "leave", "rank": self.rank})
+        except OSError:
+            pass  # coordinator already gone; nothing to say goodbye to
+
+    # -- protocol --------------------------------------------------------
+
+    def _rpc(self, obj: Dict) -> Dict:
+        return control.request(self._addr, obj, timeout=self._rpc_timeout)
+
+    def _absorb(self, resp: Dict) -> None:
+        """Fold a coordinator response's view into the local mirror.
+        Same-epoch responses still refresh members (ranks JOINING during
+        formation don't bump the epoch — only losses do)."""
+        with self._lock:
+            epoch = int(resp.get("epoch", -1))
+            if epoch > self._epoch:
+                self._epoch = epoch
+                self._members = tuple(resp.get("members", ()))
+                obs_metrics.gauge_set("elastic.epoch", epoch)
+            elif epoch == self._epoch and "members" in resp:
+                self._members = tuple(resp["members"])
+            self._world = int(resp.get("world", self._world))
+            if resp.get("status") == "rejected":
+                self._fenced = True
+
+    def _beat(self) -> None:
+        fails = 0
+        while not self._stop.wait(self.interval):
+            try:
+                resilience.fault_point(f"elastic.heartbeat.r{self.rank}")
+            except resilience.InjectedFault as e:
+                if e.kind == "heartbeat_loss":
+                    # network partition simulation: the process keeps
+                    # computing but the coordinator hears nothing
+                    self._silenced = True
+                    log.warning("elastic: rank %d heartbeats silenced "
+                                "(injected heartbeat_loss)", self.rank)
+                    return
+                raise
+            try:
+                resp = self._rpc({"cmd": "heartbeat", "rank": self.rank})
+            except OSError as e:
+                fails += 1
+                if fails >= self.MAX_RPC_FAILURES:
+                    with self._lock:
+                        self._coord_down = True
+                    obs_spans.instant("elastic.coordinator_lost",
+                                      rank=self.rank, failures=fails)
+                    log.warning(
+                        "elastic: rank %d lost the coordinator after %d "
+                        "failed heartbeats (%s: %s)", self.rank, fails,
+                        type(e).__name__, e)
+                    return
+                continue
+            fails = 0
+            self._absorb(resp)
+            if resp.get("status") == "rejected":
+                return  # fenced off: no point heartbeating further
+
+    # -- views + guards --------------------------------------------------
+
+    def view(self) -> MemberView:
+        with self._lock:
+            return MemberView(self._epoch, self._members, self._world)
+
+    def wait_formed(self, timeout_s: Optional[float] = None) -> MemberView:
+        """Block until every rank of the initial world has JOINED (or
+        already been declared dead — a gang can form short-handed if a
+        member died during startup).  The formation analog of
+        ``jax.distributed.initialize``'s rendezvous."""
+        deadline = time.monotonic() + (self._join_timeout
+                                       if timeout_s is None else timeout_s)
+        while True:
+            if self.coordinator_down:
+                raise CoordinatorLost(
+                    f"rank {self.rank}: coordinator lost while waiting "
+                    f"for the gang to form")
+            try:
+                resp = self._rpc({"cmd": "status"})
+            except OSError:
+                resp = None
+            if resp is not None:
+                self._absorb(resp)
+                world = int(resp.get("world", 0))
+                if world and (len(resp.get("members", ()))
+                              + len(resp.get("dead", {})) >= world):
+                    return self.view()
+            if time.monotonic() >= deadline:
+                raise CylonError(
+                    Code.ExecutionError,
+                    f"rank {self.rank}: gang did not form (members="
+                    f"{list(self.members)} of world {self._world})")
+            time.sleep(self.interval)
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def members(self) -> Tuple[int, ...]:
+        with self._lock:
+            return self._members
+
+    @property
+    def coordinator_down(self) -> bool:
+        with self._lock:
+            return self._coord_down
+
+    @property
+    def silenced(self) -> bool:
+        """True once the ``heartbeat_loss`` fault silenced this agent's
+        heartbeats (test-observable only): guards deliberately do NOT
+        consult it — a genuinely partitioned process cannot know it is
+        partitioned, so the silenced agent keeps computing on its stale
+        view until the coordinator's rejection fences it."""
+        return self._silenced
+
+    def ensure_epoch(self, epoch: int) -> None:
+        """The per-pass guard: raise if membership moved under us (or we
+        lost the coordinator / were fenced) since ``epoch`` was read."""
+        with self._lock:
+            if self._coord_down:
+                raise CoordinatorLost(
+                    f"rank {self.rank}: coordinator at "
+                    f"{self._addr[0]}:{self._addr[1]} unreachable "
+                    f"({self.MAX_RPC_FAILURES} heartbeats failed)")
+            if self._fenced:
+                raise EpochChanged(
+                    f"rank {self.rank} was declared dead at epoch "
+                    f"{self._epoch} (straggler fenced off)")
+            if self._epoch != epoch:
+                raise EpochChanged(
+                    f"membership epoch moved {epoch} -> {self._epoch} "
+                    f"(members now {list(self._members)})")
+
+    def barrier(self, name: str, epoch: int) -> MemberView:
+        """Rendezvous with every live member of ``epoch``.  Polled (one
+        short RPC per heartbeat interval) so failure detection keeps
+        running while we wait; raises `EpochChanged` the moment the
+        epoch moves — or if we arrive carrying a stale epoch — and
+        `CoordinatorLost` when the coordinator stops answering."""
+        fails = 0
+        while True:
+            # NOT ensure_epoch: whether a barrier at `epoch` still stands
+            # is the COORDINATOR's call (a completed barrier is latched —
+            # a finished member's clean leave bumps the local epoch
+            # mirror without invalidating it); only local terminal states
+            # short-circuit the poll
+            with self._lock:
+                if self._coord_down:
+                    raise CoordinatorLost(
+                        f"rank {self.rank}: coordinator unreachable at "
+                        f"barrier {name!r}")
+                if self._fenced:
+                    raise EpochChanged(
+                        f"rank {self.rank} was declared dead "
+                        f"(straggler fenced off at barrier {name!r})")
+            try:
+                resp = self._rpc({"cmd": "barrier", "rank": self.rank,
+                                  "name": name, "epoch": epoch})
+            except OSError as e:
+                fails += 1
+                if fails >= self.MAX_RPC_FAILURES:
+                    with self._lock:
+                        self._coord_down = True
+                    raise CoordinatorLost(
+                        f"rank {self.rank}: coordinator unreachable at "
+                        f"barrier {name!r} ({fails} attempts: "
+                        f"{type(e).__name__}: {e})") from e
+                time.sleep(self.interval)
+                continue
+            fails = 0
+            self._absorb(resp)
+            status = resp.get("status")
+            if status == "go":
+                return self.view()
+            if status in ("epoch_changed", "rejected"):
+                obs_spans.instant("elastic.straggler_rejected"
+                                  if status == "rejected"
+                                  else "elastic.epoch_bump",
+                                  rank=self.rank, barrier=name,
+                                  stale_epoch=epoch)
+                self.ensure_epoch(epoch)  # raises with the precise reason
+                raise EpochChanged(      # fenced before any view advanced
+                    f"rank {self.rank} rejected at barrier {name!r} "
+                    f"(stale epoch {epoch})")
+            time.sleep(self.interval)
+
+    def report_failure(self, status: Status, peer: Optional[int] = None
+                       ) -> None:
+        """Indict a peer (or record a local classified failure) with the
+        coordinator — the `Status`-classified path for collective
+        failures that implicate a specific rank."""
+        try:
+            resp = self._rpc({"cmd": "report_failure", "rank": self.rank,
+                              "peer": peer, "code": status.code.name,
+                              "msg": status.msg})
+        except OSError:
+            return  # detection falls back to heartbeat timeout
+        self._absorb(resp)
+
+
+def connect(rank: int, address: Optional[str] = None) -> Agent:
+    """Agent from the knob configuration (``CYLON_TPU_ELASTIC_COORD``),
+    started."""
+    addr = address or coordinator_address()
+    if not addr:
+        raise CylonError(Code.Invalid,
+                         "CYLON_TPU_ELASTIC_COORD is unset: an elastic "
+                         "context needs a coordinator address")
+    return Agent(addr, rank).start()
+
+
+# ---------------------------------------------------------------------------
+# work assignment + the shrink-and-resume loop
+# ---------------------------------------------------------------------------
+
+def owned_parts(n_parts: int, rank: int,
+                members: Sequence[int]) -> List[int]:
+    """The key-domain parts ``rank`` owns under ``members``: part ``p``
+    belongs to ``members[p % len(members)]`` (members sorted).  Purely a
+    function of (n_parts, membership), so every survivor derives the
+    SAME cover of 0..n_parts-1 with no extra coordination — a dead
+    rank's parts redistribute onto survivors by construction."""
+    ms = sorted(members)
+    if rank not in ms:
+        raise EpochChanged(f"rank {rank} not in members {ms}")
+    i = ms.index(rank)
+    return [p for p in range(n_parts) if p % len(ms) == i]
+
+
+@dataclass
+class ElasticSlice:
+    """One epoch's slice of an elastic run, handed to the engine: the
+    owned part ids, the epoch/world they were derived at (journaled as
+    per-pass provenance), and the guard the engine calls between passes
+    to abandon in-flight work on membership drift."""
+
+    parts: List[int]
+    epoch: int
+    world: int
+    guard: Callable[[], None]
+
+
+def elastic_run(agent: Agent, n_parts: int,
+                run_parts: Callable[[ElasticSlice], object],
+                finalize: Optional[Callable[[], object]] = None,
+                run_id: str = "",
+                barrier_name: str = "cylon-elastic-done"):
+    """Drive one fingerprinted run to completion across membership
+    changes.
+
+    Each iteration (one epoch): derive this rank's parts over the live
+    membership, execute them through ``run_parts`` (the journaled
+    engine — completed parts spill to the shared journal, parts any
+    rank already journaled are consumed instead of re-executed), then
+    rendezvous.  `EpochChanged` anywhere in that sequence restarts the
+    iteration at the new membership — the gang re-init (XLA cannot
+    reshape a live mesh, so survivors re-form rather than patch).  When
+    the rendezvous completes, every part of the run is durably
+    journaled and ``finalize`` (typically the same engine invocation
+    over ALL parts, which then serves everything from the journal)
+    assembles the bit-identical result.
+
+    Raises `CoordinatorLost` (clean, classified) when the control plane
+    dies, and `EpochChanged` when THIS rank was fenced off as dead — a
+    straggler must stand down, not assemble output.
+
+    ``run_id`` MUST be identical on every rank and unique per logical
+    run (the durable run fingerprint is the natural choice): completed
+    rendezvous are LATCHED per (barrier name, epoch) on the coordinator
+    so a finished member's clean leave cannot fake an epoch change for
+    the others — which means a SECOND run reusing the same name at the
+    same epoch would rendezvous instantly against the stale latch,
+    before its peers journaled anything.  The name is therefore
+    namespaced by ``run_id``."""
+    resumes = 0
+    barrier_name = f"{barrier_name}/{run_id}/{n_parts}"
+    agent.wait_formed()
+    max_iters = 4 * max(agent.view().world, 1) + 8
+    with obs_spans.span("elastic.run", rank=agent.rank, n_parts=n_parts):
+        for _ in range(max_iters):
+            try:
+                # the WHOLE derivation sits inside the try: an epoch bump
+                # absorbed by the heartbeat thread between view() and
+                # ensure_epoch() is an ordinary resume for a healthy
+                # survivor, not a reason to escape the loop (the except
+                # arm's membership re-check decides true fencing)
+                view = agent.view()
+                agent.ensure_epoch(view.epoch)  # coordinator/fencing
+                view.require_member(agent.rank)
+                sl = ElasticSlice(
+                    parts=owned_parts(n_parts, agent.rank, view.members),
+                    epoch=view.epoch, world=len(view.members),
+                    guard=_make_guard(agent, view.epoch))
+                run_parts(sl)
+                agent.barrier(barrier_name, view.epoch)
+            except EpochChanged as e:
+                if agent.view().members and \
+                        agent.rank not in agent.view().members:
+                    raise  # we are the straggler: stand down
+                resumes += 1
+                obs_spans.instant("elastic.resume", rank=agent.rank,
+                                  from_epoch=view.epoch,
+                                  to_epoch=agent.epoch, reason=e.msg[:120])
+                obs_metrics.counter_add("elastic.resume")
+                log.warning("elastic: rank %d resuming at epoch %d "
+                            "(was %d): %s", agent.rank, agent.epoch,
+                            view.epoch, e.msg)
+                continue
+            return finalize() if finalize is not None else None
+    raise CylonError(
+        Code.ExecutionError,
+        f"elastic run did not stabilize after {resumes} membership "
+        f"changes ({max_iters} iterations)")
+
+
+def _make_guard(agent: Agent, epoch: int) -> Callable[[], None]:
+    """Per-pass guard bound to the epoch the slice was derived at.  The
+    fault probe runs FIRST so ``rank_kill`` fires at exactly the pass
+    boundary a preemption would."""
+    def guard() -> None:
+        resilience.fault_point(f"elastic.pass.r{agent.rank}")
+        agent.ensure_epoch(epoch)
+    return guard

@@ -585,7 +585,8 @@ class _RefinablePlan:
 
 
 def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
-                        prefetch=True, progress=True, journal=None):
+                        prefetch=True, progress=True, journal=None,
+                        parts=None, pass_guard=None):
     """The resilient streaming loop: checkpointed host frames + adaptive
     pass-splitting + bounded transient retry.
 
@@ -615,6 +616,14 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
       retries in place under ``policy``'s exponential backoff.
     - anything else — propagates unchanged (a TypeError stays a bug).
 
+    Elastic execution (PR 6): ``parts`` restricts the stream to a subset
+    of the plan's level-0 part ids (this process's slice of an elastic
+    gang; part ids stay GLOBAL so the shared journal is coherent across
+    ranks and world sizes), and ``pass_guard`` is called before every
+    pass — `elastic.EpochChanged` / `elastic.CoordinatorLost` raised
+    there carry non-retryable codes, so they abandon in-flight work and
+    propagate straight to the elastic loop instead of burning retries.
+
     Poison-pass quarantine (``CYLON_TPU_QUARANTINE_AFTER`` = N > 0): a
     head part failing with the SAME classified code N consecutive times
     is dropped from the stream and reported in ``stats["quarantined"]``
@@ -631,7 +640,10 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
 
     frames: List[Dict[str, np.ndarray]] = []
     total = 0
-    remaining = list(range(n_parts0)) if n_parts0 is not None else None
+    if parts is not None and n_parts0 is not None:
+        remaining = sorted(int(p) for p in parts if 0 <= int(p) < n_parts0)
+    else:
+        remaining = list(range(n_parts0)) if n_parts0 is not None else None
     level = 0
     part_retries = 0  # transient retries of the current head part
     atom_watch: set = set()  # child ids of a head atom already split once
@@ -827,6 +839,12 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
         try:
             nxt = chunk(remaining[0]) if prefetch else None
             while cursor < len(remaining):
+                if pass_guard is not None:
+                    # elastic epoch/membership guard: EpochChanged /
+                    # CoordinatorLost carry non-retryable codes, so
+                    # recover() propagates them — in-flight work is
+                    # abandoned, never retried into a changed world
+                    pass_guard()
                 part = remaining[cursor]
                 if journal is not None:
                     hit = journal.load_pass(level, part)
@@ -945,7 +963,8 @@ def _concat_host(frames: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
 def chunked_join(left, right, *, on=None, left_on=None, right_on=None,
                  how: str = "inner", passes: int = 4, algo: str = "sort",
                  mode: str = "auto", ctx=None, prefetch: bool = True,
-                 left_prefix: str = "l_", right_prefix: str = "r_"):
+                 left_prefix: str = "l_", right_prefix: str = "r_",
+                 elastic=None):
     """Out-of-core join over host frames (pandas/dict/Table): the key
     domain is split into ``passes`` parts, each part joined on device by
     one shared compiled program, outputs concatenated on the host.  All
@@ -957,7 +976,7 @@ def chunked_join(left, right, *, on=None, left_on=None, right_on=None,
                            agg=None, passes=passes, algo=algo, ddof=0,
                            mode=mode, ctx=ctx, prefetch=prefetch,
                            left_prefix=left_prefix,
-                           right_prefix=right_prefix)
+                           right_prefix=right_prefix, elastic=elastic)
 
 
 def chunked_join_groupby_tables(left, right, *, on=None, left_on=None,
@@ -965,7 +984,7 @@ def chunked_join_groupby_tables(left, right, *, on=None, left_on=None,
                                 group_by, agg: Dict, passes: int = 4,
                                 algo: str = "sort", ddof: int = 0,
                                 mode: str = "auto", ctx=None,
-                                prefetch: bool = True):
+                                prefetch: bool = True, elastic=None):
     """Out-of-core join + group-by over host frames.  ``group_by`` and
     ``agg`` use POST-JOIN column names (collisions prefixed l_/r_, as
     Table.join names them).  When the group keys pin down the
@@ -980,12 +999,14 @@ def chunked_join_groupby_tables(left, right, *, on=None, left_on=None,
     return _chunked_engine(left, right, on=on, left_on=left_on,
                            right_on=right_on, how=how, group_by=group_by,
                            agg=agg, passes=passes, algo=algo, ddof=ddof,
-                           mode=mode, ctx=ctx, prefetch=prefetch)
+                           mode=mode, ctx=ctx, prefetch=prefetch,
+                           elastic=elastic)
 
 
 def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
                     agg, passes, algo, ddof, mode, ctx, prefetch,
-                    left_prefix: str = "l_", right_prefix: str = "r_"):
+                    left_prefix: str = "l_", right_prefix: str = "r_",
+                    elastic=None):
     t_plan0 = time.perf_counter()
     names_l, arrs_l = _as_host_frame(left)
     names_r, arrs_r = _as_host_frame(right)
@@ -1049,6 +1070,12 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
 
     world = 1 if ctx is None else ctx.GetWorldSize()
     if world > 1:
+        if elastic is not None:
+            raise CylonError(
+                Code.Invalid,
+                "elastic execution drives one local mesh per process "
+                "(gang re-init on membership change); pass ctx=None — a "
+                "live multi-device mesh cannot be reshaped under a run")
         return _chunked_distributed(
             arrs_l, names_l, arrs_r, names_r, lon, ron, cfg, joined,
             pid_l, pid_r, n_passes, counts_l, counts_r, gb_names, aggs_req,
@@ -1132,7 +1159,14 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
              if aggs_req is not None else None,
              int(ddof), int(n_passes), mode_used, 1),
             ((names_l, arrs_l), (names_r, arrs_r)))
-        journal = durable.open_run(fp, op)
+        # the fingerprint is world-INDEPENDENT by design: an elastic gang
+        # at any membership (and a single-process re-invocation) shares
+        # one journal; the slice's world/epoch ride the manifest as
+        # per-pass provenance only
+        journal = durable.open_run(
+            fp, op,
+            world=None if elastic is None else elastic.world,
+            epoch=None if elastic is None else elastic.epoch)
 
     def make_exec(parts, level):
         pid_l_lvl, pid_r_lvl = plan.pids(level)
@@ -1168,7 +1202,9 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
 
     t_plan, t_run0, frames, total = _stream_recoverable(
         make_exec, plan, t_plan0, policy=policy, stats=stats,
-        prefetch=prefetch, journal=journal)
+        prefetch=prefetch, journal=journal,
+        parts=None if elastic is None else elastic.parts,
+        pass_guard=None if elastic is None else elastic.guard)
     result = _concat_host(frames)
     if gb_names is not None and not final_per_pass:
         result, total = _combine_partials(result, gb_names, aggs_req,
@@ -1348,7 +1384,7 @@ def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
 # ---------------------------------------------------------------------------
 
 def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
-                    mode: str = "auto", ctx=None):
+                    mode: str = "auto", ctx=None, elastic=None):
     """Out-of-core group-by over one host frame: the key domain is
     partitioned on the GROUP columns themselves, so every pass's
     group-by is final (a group never spans passes) and the results just
@@ -1371,6 +1407,10 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
                                   for n, op in aggs_req]
 
     world = 1 if ctx is None else ctx.GetWorldSize()
+    if world > 1 and elastic is not None:
+        raise CylonError(Code.Invalid,
+                         "elastic execution drives one local mesh per "
+                         "process; pass ctx=None")
     frames: List[Dict[str, np.ndarray]] = []
     total = 0
     if world > 1:
@@ -1411,7 +1451,10 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
                  tuple((n, int(o)) for n, o in aggs_req),
                  int(ddof), int(n_passes), mode_used, 1),
                 ((names, arrs),))
-            journal = durable.open_run(fp, "groupby")
+            journal = durable.open_run(
+                fp, "groupby",
+                world=None if elastic is None else elastic.world,
+                epoch=None if elastic is None else elastic.epoch)
 
         def make_exec(parts, level):
             pid_lvl, _ = plan.pids(level)
@@ -1430,7 +1473,9 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
             return build.chunk, prog, fetch
 
         t_plan, t_run0, frames, total = _stream_recoverable(
-            make_exec, plan, t0, stats=extra, journal=journal)
+            make_exec, plan, t0, stats=extra, journal=journal,
+            parts=None if elastic is None else elastic.parts,
+            pass_guard=None if elastic is None else elastic.guard)
     result = _concat_host(frames)
     t_run = time.perf_counter() - t_run0
     stats = {"passes": n_passes, "mode": mode_used, "world": world,

@@ -1,0 +1,120 @@
+"""Control-plane transport: one-shot JSON requests over TCP.
+
+The DATA plane is XLA collectives (parallel/shuffle.py) — program order,
+no host protocol.  The CONTROL plane (cylon_tpu/elastic.py: membership,
+heartbeats, rendezvous) needs what MPI got from its runtime daemons and
+the reference got from ``mpirun`` (PAPER.md §5 gang restart): a tiny
+out-of-band channel that keeps working while the data plane is wedged.
+
+The protocol is deliberately minimal — one connection per request, one
+JSON object per line each way — so there is no framing state to desync,
+no multiplexing lock to deadlock behind a blocked barrier, and a died
+peer is indistinguishable from a refused connect (both surface as
+``OSError``, which the caller classifies).  On localhost (the CI
+rendering) a connect costs microseconds; on a pod the control plane is
+off the critical path by construction (heartbeat cadence, not per-op).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+MAX_LINE = 1 << 20  # a control message is small; a longer line is a bug
+
+
+def send_json(sock: socket.socket, obj: Dict) -> None:
+    """One JSON object, newline-terminated, in a single send."""
+    sock.sendall(json.dumps(obj, sort_keys=True).encode() + b"\n")
+
+
+def recv_json(sock: socket.socket) -> Dict:
+    """Read one newline-terminated JSON object (bounded by MAX_LINE)."""
+    buf = bytearray()
+    while not buf.endswith(b"\n"):
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("control peer closed mid-message")
+        buf.extend(chunk)
+        if len(buf) > MAX_LINE:
+            raise ConnectionError("control message exceeds MAX_LINE")
+    return json.loads(buf.decode())
+
+
+def request(address: Tuple[str, int], obj: Dict,
+            timeout: float = 5.0) -> Dict:
+    """One request/response round trip on a fresh connection.
+
+    Raises ``OSError`` (incl. ``ConnectionError``/``socket.timeout``)
+    when the peer is down/unreachable — the caller owns classification
+    (the elastic agent turns repeated failures into coordinator loss).
+    """
+    with socket.create_connection(address, timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        send_json(sock, obj)
+        return recv_json(sock)
+
+
+class JsonServer:
+    """Threaded accept loop serving one request per connection.
+
+    ``handler(request_dict) -> response_dict`` runs on a per-connection
+    thread; handler exceptions are answered as ``{"ok": False, "error":
+    ...}`` instead of tearing the connection (the client sees a clean
+    protocol-level failure, not a reset).  Binding port 0 reserves an
+    ephemeral port atomically — the listening socket IS the reservation,
+    so there is no bind-then-rebind TOCTOU window (the _free_port() race
+    the multihost test had).
+    """
+
+    def __init__(self, handler: Callable[[Dict], Dict],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "JsonServer":
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="cylon-control-serve")
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed: server death or clean stop
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                conn.settimeout(5.0)
+                req = recv_json(conn)
+            except (OSError, ValueError):
+                return  # malformed/garbled request: drop the connection
+            try:
+                resp = self._handler(req)
+            except Exception as e:
+                resp = {"ok": False,
+                        "error": f"{type(e).__name__}: {e}"}
+            try:
+                send_json(conn, resp)
+            except OSError:
+                pass  # client went away before the reply; nothing to do
+
+    def close(self) -> None:
+        """Stop accepting and release the port (idempotent)."""
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

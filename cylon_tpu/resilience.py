@@ -154,6 +154,19 @@ _KIND_MESSAGES = {
     "killhard": "injected hard kill at {site} (hit {hit})",
     "journal_corrupt": "injected spill corruption at {site} (hit {hit})",
     "hang": "injected hang at {site} (hit {hit})",
+    # elastic-membership kinds (PR 6): `rank_kill` is killhard under an
+    # elastic name (os._exit(137) at a pass boundary — a preempted /
+    # kill -9'd gang member); `heartbeat_loss` raises at the agent's
+    # heartbeat probe, which CATCHES it and goes permanently silent (a
+    # network partition: the process keeps computing, the coordinator
+    # hears nothing); `coordinator_loss` raises at the coordinator's
+    # detector probe, which catches it and drops the control socket
+    # (the membership ground truth dies mid-run)
+    "rank_kill": "injected rank kill at {site} (hit {hit})",
+    "heartbeat_loss": ("UNAVAILABLE: injected heartbeat loss at {site} "
+                       "(hit {hit}): network error"),
+    "coordinator_loss": ("UNAVAILABLE: injected coordinator loss at {site} "
+                         "(hit {hit}): connection closed"),
 }
 
 FAULT_KINDS = tuple(_KIND_MESSAGES)
@@ -281,9 +294,11 @@ def fault_point(site: str) -> None:
         obs_spans.instant("fault.injected", site=site, kind=kind,
                           hit=plan.hits[site])
         obs_metrics.counter_add("fault.injected")
-        if kind == "killhard":
+        if kind in ("killhard", "rank_kill"):
             # simulate kill -9 / preemption: no cleanup, no atexit, no
             # flushed buffers — exactly what the journal must survive
+            # (rank_kill is the elastic-membership spelling: survivors
+            # must detect the silence, shrink, and resume)
             os._exit(137)
         if kind == "journal_corrupt":
             from . import durable

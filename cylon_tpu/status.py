@@ -33,6 +33,13 @@ class Code(enum.IntEnum):
     ExecutionError = 42
     AlreadyExists = 45
     Timeout = 46
+    # elastic-membership codes (PR 6; like Timeout, extensions past the
+    # reference's table).  Neither is retryable: a lost coordinator has
+    # no one to retry against, and re-running a pass into a changed
+    # membership is the desync PR 1's no-retry-collectives rule bans —
+    # the elastic loop re-PLANS at the new world instead.
+    Unavailable = 47      # control plane (coordinator) gone
+    EpochMismatch = 48    # membership moved under in-flight work
 
 
 # Failure-text classification tables (lowercase substrings).  PJRT raises

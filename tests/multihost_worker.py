@@ -27,9 +27,22 @@ from cylon_tpu import CylonContext, Table, TPUConfig  # noqa: E402
 
 
 def main() -> int:
-    ctx = CylonContext.InitDistributed(TPUConfig(
-        coordinator_address=f"127.0.0.1:{port}",
-        num_processes=nprocs, process_id=pid))
+    try:
+        ctx = CylonContext.InitDistributed(TPUConfig(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=nprocs, process_id=pid))
+    except RuntimeError as e:
+        # the parent's _free_port() reservation is inherently TOCTOU (the
+        # port must be released for the jax coordinator to bind it): a
+        # lost race surfaces here as a bind failure — report EX_TEMPFAIL
+        # so the parent retries the gang on a fresh port instead of
+        # failing the test
+        low = str(e).lower()
+        if "address already in use" in low or "bind" in low:
+            print(f"proc {pid}: coordinator port race on {port}: {e}",
+                  flush=True)
+            return 75  # tests/test_multihost.py BIND_RACE_RC
+        raise
     assert jax.process_count() == nprocs, jax.process_count()
     world = ctx.GetWorldSize()
     assert world == 4 * nprocs, world

@@ -1,0 +1,507 @@
+"""Elastic multi-process membership (cylon_tpu/elastic.py): epochs,
+heartbeat failure detection, rendezvous barriers, and journal-backed
+shrink-and-resume.
+
+The acceptance-criterion path: a 3-process gang with one member killed
+(``rank_kill`` = ``os._exit(137)`` at a pass boundary) mid-plan
+completes on the 2 survivors with output bit-identical to the
+single-process oracle, served partly from the shared durable journal.
+Every recovery path — rank_kill, heartbeat_loss (silent straggler),
+coordinator_loss, epoch-mismatch at the barrier, journaled-at-W
+consumed at W-1 — runs deterministically on CPU via the resilience
+fault plans.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cylon_tpu import config, elastic, resilience
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.status import Code, CylonError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tight-but-safe control-plane cadence for in-process tests: detection
+# within ~0.5s, heartbeats every 50ms
+HB = dict(interval_s=0.05, timeout_s=0.5)
+HB_TIMEOUT = 0.4
+
+
+def _gang(world, **kw):
+    c = elastic.Coordinator(world, heartbeat_timeout_s=HB_TIMEOUT,
+                            **kw).start()
+    addr = f"{c.address[0]}:{c.address[1]}"
+    agents = [elastic.Agent(addr, r, **HB).start() for r in range(world)]
+    return c, addr, agents
+
+
+def _wait(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.02)
+
+
+def _assert_bit_identical(a: dict, b: dict) -> None:
+    assert set(a) == set(b)
+    for k in a:
+        x, y = np.asarray(a[k]), np.asarray(b[k])
+        assert x.dtype == y.dtype, (k, x.dtype, y.dtype)
+        np.testing.assert_array_equal(x, y, err_msg=k)
+        if x.dtype.kind == "f":
+            np.testing.assert_array_equal(x.view(np.uint8), y.view(np.uint8),
+                                          err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# work assignment
+# ---------------------------------------------------------------------------
+
+def test_owned_parts_cover_disjoint_and_redistribute():
+    members = [0, 1, 2]
+    covers = [elastic.owned_parts(7, r, members) for r in members]
+    assert sorted(p for c in covers for p in c) == list(range(7))
+    assert all(len(set(c)) == len(c) for c in covers)
+    # shrink: the dead rank's parts land on survivors, full cover kept
+    shrunk = [elastic.owned_parts(7, r, [0, 2]) for r in (0, 2)]
+    assert sorted(p for c in shrunk for p in c) == list(range(7))
+    with pytest.raises(elastic.EpochChanged):
+        elastic.owned_parts(7, 1, [0, 2])  # dead ranks own nothing
+
+
+def test_epoch_codes_are_not_retryable():
+    # retrying into a changed membership is the desync PR 1 bans: the
+    # elastic loop must re-plan, so neither code may enter the retry path
+    assert Code.EpochMismatch not in resilience.RETRYABLE_CODES
+    assert Code.Unavailable not in resilience.RETRYABLE_CODES
+    assert elastic.EpochChanged("x").code == Code.EpochMismatch
+    assert elastic.CoordinatorLost("x").code == Code.Unavailable
+
+
+# ---------------------------------------------------------------------------
+# membership: formation, silence detection, epoch bumps
+# ---------------------------------------------------------------------------
+
+def test_silent_rank_bumps_epoch_and_shrinks_membership():
+    obs_metrics.reset()
+    c, _, agents = _gang(3)
+    try:
+        v = agents[0].wait_formed()
+        assert v.epoch == 0 and v.members == (0, 1, 2) and v.world == 3
+        agents[1].stop()  # process-death semantics: just goes silent
+        _wait(lambda: agents[0].view().members == (0, 2),
+              msg="rank 1 reaped")
+        v2 = agents[0].view()
+        assert v2.epoch == 1
+        assert obs_metrics.counter_value("elastic.rank_lost") == 1
+        with pytest.raises(elastic.EpochChanged) as ei:
+            agents[0].ensure_epoch(0)
+        assert ei.value.code == Code.EpochMismatch
+        agents[0].ensure_epoch(1)  # current epoch passes the guard
+    finally:
+        for a in agents:
+            a.stop()
+        c.stop()
+        obs_metrics.reset()
+
+
+def test_reported_peer_failure_bumps_epoch():
+    from cylon_tpu.status import Status
+
+    c, _, agents = _gang(2)
+    try:
+        agents[0].wait_formed()
+        # a collective failure classified via Status indicts the peer
+        agents[1].report_failure(
+            Status(Code.ExecutionError, "UNAVAILABLE: peer unreachable"),
+            peer=0)
+        _wait(lambda: agents[1].view().members == (1,),
+              msg="reported peer reaped")
+        assert agents[1].view().epoch == 1
+        assert "reported by rank 1" in c._dead[0]
+    finally:
+        for a in agents:
+            a.stop()
+        c.stop()
+
+
+def test_barrier_rendezvous_and_epoch_change_mid_wait():
+    import threading
+
+    c, _, agents = _gang(2)
+    try:
+        agents[0].wait_formed()
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(agents[1].barrier("done", 0)))
+        t.start()
+        v = agents[0].barrier("done", 0)
+        t.join(5)
+        assert out and v.epoch == 0 and out[0].epoch == 0
+        # now rank 1 dies while rank 0 waits: the barrier must not hang —
+        # the epoch bump converts the wait into EpochChanged
+        agents[1].stop()
+        with pytest.raises(elastic.EpochChanged):
+            agents[0].barrier("again", 0)
+        assert agents[0].view().members == (0,)
+        # the survivor alone completes the epoch-1 barrier immediately
+        assert agents[0].barrier("again", 1).members == (0,)
+    finally:
+        for a in agents:
+            a.stop()
+        c.stop()
+
+
+def test_barrier_latch_is_per_name_and_not_reused_across_runs():
+    """A completed rendezvous is LATCHED (a finished member's clean
+    leave must not fake an epoch change for the still-polling peers) —
+    but the latch is keyed by barrier NAME, so a second run's barrier
+    (namespaced by run_id in elastic_run) starts fresh instead of
+    rendezvousing instantly against the first run's latch."""
+    import threading
+
+    from cylon_tpu.net import control
+
+    c, _, agents = _gang(2)
+    try:
+        agents[0].wait_formed()
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(agents[1].barrier("done/run1/6", 0)))
+        t.start()
+        agents[0].barrier("done/run1/6", 0)
+        t.join(5)
+        assert out
+        # the latch keeps serving go for run1's name at epoch 0...
+        resp = control.request(c.address, {"cmd": "barrier", "rank": 0,
+                                           "name": "done/run1/6",
+                                           "epoch": 0})
+        assert resp["status"] == "go"
+        # ...but a different run's name at the same epoch is NOT
+        # pre-completed: the peer has not arrived, so rank 0 must wait
+        resp = control.request(c.address, {"cmd": "barrier", "rank": 0,
+                                           "name": "done/run2/6",
+                                           "epoch": 0})
+        assert resp["status"] == "wait"
+    finally:
+        for a in agents:
+            a.stop()
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault kinds: heartbeat_loss (straggler), coordinator_loss
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_heartbeat_loss_straggler_rejected_at_barrier():
+    """The heartbeat_loss kind silences rank 1's heartbeats while the
+    process keeps computing: the coordinator declares it dead, and its
+    eventual barrier — carrying the stale epoch — is REJECTED (fenced),
+    never admitted into the shrunken world."""
+    with resilience.fault_plan("elastic.heartbeat.r1@2=heartbeat_loss") as p:
+        c, _, agents = _gang(2)
+        try:
+            agents[0].wait_formed()
+            _wait(lambda: agents[0].view().members == (0,),
+                  msg="silenced rank declared dead")
+            assert ("elastic.heartbeat.r1", "heartbeat_loss", 2) in p.fired
+            # the straggler still believes epoch 0 (it hears nothing; the
+            # silenced flag is test-observable, guards never consult it —
+            # a partitioned process cannot know it is partitioned)
+            assert agents[1].silenced
+            assert agents[1].view().epoch == 0
+            with pytest.raises(elastic.EpochChanged) as ei:
+                agents[1].barrier("done", 0)
+            assert "dead" in ei.value.msg or "straggler" in ei.value.msg
+            with pytest.raises(elastic.EpochChanged):
+                agents[1].ensure_epoch(0)  # fenced: every guard refuses
+        finally:
+            for a in agents:
+                a.stop()
+            c.stop()
+
+
+@pytest.mark.fault
+def test_coordinator_loss_fails_clean_with_status():
+    """The coordinator_loss kind kills the coordinator at its detector
+    tick: agents must detect the silence within a bounded number of
+    heartbeats and fail with a classified Status (Code.Unavailable) —
+    never hang."""
+    with resilience.fault_plan("elastic.coordinator@2=coordinator_loss"):
+        c, _, agents = _gang(1)
+        try:
+            agents[0].wait_formed()
+            _wait(lambda: c.died, msg="coordinator death")
+            _wait(lambda: agents[0].coordinator_down,
+                  msg="agent detects coordinator loss")
+            with pytest.raises(elastic.CoordinatorLost) as ei:
+                agents[0].ensure_epoch(0)
+            assert ei.value.code == Code.Unavailable
+            with pytest.raises(elastic.CoordinatorLost):
+                agents[0].barrier("done", 0)
+        finally:
+            agents[0].stop()
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# context integration
+# ---------------------------------------------------------------------------
+
+def test_elastic_config_context_joins_and_leaves():
+    from cylon_tpu.context import CylonContext, ElasticConfig
+
+    c = elastic.Coordinator(1, heartbeat_timeout_s=HB_TIMEOUT).start()
+    try:
+        addr = f"{c.address[0]}:{c.address[1]}"
+        ctx = CylonContext.InitDistributed(
+            ElasticConfig(rank=0, coordinator=addr, world_size=1))
+        agent = ctx.elastic_agent()
+        assert agent is not None and ctx.GetRank() == 0
+        assert agent.wait_formed().members == (0,)
+        ctx.Finalize()  # clean leave: the coordinator reaps us instantly
+        _wait(lambda: c.view().members == (), msg="clean leave")
+    finally:
+        c.stop()
+
+
+def test_env_driven_elastic_opt_in_joins_gang():
+    """CYLON_TPU_ELASTIC=1 + _ELASTIC_COORD: a plain distributed
+    context joins the gang at its process id with no code changes (the
+    deployment path where hosts only get environment variables)."""
+    from cylon_tpu.context import CylonContext, TPUConfig
+
+    c = elastic.Coordinator(1, heartbeat_timeout_s=HB_TIMEOUT).start()
+    try:
+        addr = f"{c.address[0]}:{c.address[1]}"
+        with config.knob_env(CYLON_TPU_ELASTIC="1",
+                             CYLON_TPU_ELASTIC_COORD=addr):
+            ctx = CylonContext.InitDistributed(TPUConfig(world_size=1))
+        agent = ctx.elastic_agent()
+        assert agent is not None and agent.rank == 0
+        assert agent.wait_formed().members == (0,)
+        assert ctx.GetNeighbours(include_self=True) == [0]
+        ctx.Finalize()
+        _wait(lambda: c.view().members == (), msg="clean leave")
+        # knob off (default): no gang join
+        ctx2 = CylonContext.InitDistributed(TPUConfig(world_size=1))
+        assert ctx2.elastic_agent() is None
+    finally:
+        c.stop()
+
+
+def test_elastic_context_requires_coordinator_address():
+    from cylon_tpu.context import CylonContext, ElasticConfig
+
+    with config.knob_env(CYLON_TPU_ELASTIC_COORD=None):
+        with pytest.raises(CylonError) as ei:
+            CylonContext.InitDistributed(ElasticConfig(rank=0, world_size=1))
+    assert ei.value.code == Code.Invalid
+
+
+# ---------------------------------------------------------------------------
+# journal semantics across world sizes
+# ---------------------------------------------------------------------------
+
+# the op and inputs are the WORKER's own (tests/elastic_worker.py): the
+# in-process journal tests and the multi-process acceptance test must
+# compute the identical run fingerprint, so there is exactly one
+# definition of both
+from tests.elastic_worker import N_PASSES, inputs as _inputs, run_op as _run
+
+
+def test_journaled_at_world_w_consumed_at_w_minus_1(tmp_path):
+    """Shards journaled by a world-3 gang are consumed verbatim by the
+    world-2 survivors (part ids are global key-domain positions, so the
+    fingerprint is world-independent by design), and the manifest
+    records per-pass world/epoch provenance for the shrink history."""
+    left, right = _inputs()
+    base, base_stats = _run(left, right)
+    assert base_stats["passes"] == N_PASSES
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        # epoch 0, world 3: ranks 0 and 2 journal their slices; rank 1
+        # "dies" before contributing (its parts stay unjournaled)
+        for r in (0, 2):
+            sl = elastic.ElasticSlice(
+                parts=elastic.owned_parts(6, r, [0, 1, 2]), epoch=0,
+                world=3, guard=lambda: None)
+            _, st = _run(left, right, sl)
+            assert st["parts_run"] == 2 and st["passes_skipped"] == 0
+        # epoch 1, world 2: survivors re-derive their slices — parts
+        # journaled at world 3 are CONSUMED, only rank 1's leftovers run
+        ran = skipped = 0
+        for r in (0, 2):
+            sl = elastic.ElasticSlice(
+                parts=elastic.owned_parts(6, r, [0, 2]), epoch=1,
+                world=2, guard=lambda: None)
+            _, st = _run(left, right, sl)
+            ran += st.get("parts_run", 0)
+            skipped += st["passes_skipped"]
+        assert ran == 2 and skipped == 4  # exactly the dead rank's parts
+        # assembly: the full run serves every pass from the journal and
+        # is bit-identical to the single-process oracle
+        out, st = _run(left, right)
+        assert st["passes_skipped"] == st["passes"] == 6
+        assert "parts_run" not in st
+        _assert_bit_identical(out, base)
+        # manifest provenance: both worlds appear on pass records
+        fp_dir = next(p for p in tmp_path.iterdir() if p.is_dir())
+        entries = [json.loads(ln) for ln
+                   in (fp_dir / "MANIFEST.jsonl").read_text().splitlines()]
+        worlds = {e["world"] for e in entries if e["kind"] == "pass"}
+        epochs = {e["epoch"] for e in entries if e["kind"] == "pass"}
+        assert worlds == {3, 2} and epochs == {0, 1}
+
+
+@pytest.mark.fault
+def test_pass_guard_abandons_in_flight_work_on_epoch_change(tmp_path):
+    """An EpochChanged raised by the engine's pass guard propagates OUT
+    of the stream (no retry, no quarantine — Code.EpochMismatch is not
+    retryable) with the already-completed parts journaled."""
+    left, right = _inputs()
+    calls = {"n": 0}
+
+    def guard():
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise elastic.EpochChanged("membership epoch moved 0 -> 1")
+
+    sl = elastic.ElasticSlice(parts=[0, 1, 2, 3, 4, 5], epoch=0, world=3,
+                              guard=guard)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path),
+                         CYLON_TPU_RETRY_BASE_S="0"):
+        with pytest.raises(elastic.EpochChanged):
+            _run(left, right, sl)
+        # the two passes completed before the guard fired are journaled:
+        # the resumed invocation consumes them
+        sl2 = elastic.ElasticSlice(parts=[0, 1, 2, 3, 4, 5], epoch=1,
+                                   world=2, guard=lambda: None)
+        _, st = _run(left, right, sl2)
+    assert st["passes_skipped"] == 2
+    assert st["parts_run"] == 4
+
+
+# ---------------------------------------------------------------------------
+# multi-OS-process integration (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def _worker_env(tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS", "JAX_PLATFORMS",
+                        "CYLON_TPU_FAULT_PLAN", "CYLON_TPU_DURABLE_DIR",
+                        "CYLON_TPU_TRACE", "CYLON_TPU_TRACE_DIR")}
+    env["CYLON_TPU_DURABLE_DIR"] = str(tmp_path / "journal")
+    env["CYLON_TPU_HEARTBEAT_S"] = "0.1"
+    env["CYLON_TPU_HEARTBEAT_TIMEOUT_S"] = "0.8"
+    return env
+
+
+def _spawn_workers(tmp_path, addr, world, env_by_rank):
+    procs = []
+    for r in range(world):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tests.elastic_worker", str(r),
+             str(world), addr, str(tmp_path / f"out_r{r}.npz"),
+             str(tmp_path / f"stats_r{r}.json")],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env_by_rank[r]))
+    return procs
+
+
+def _communicate_all(procs, timeout=240):
+    """Drain every worker with a hard bound: a hung worker is KILLED in
+    the finally block so it can never leak past the tier-1 timeout."""
+    outs = [b""] * len(procs)
+    try:
+        for i, p in enumerate(procs):
+            out, _ = p.communicate(timeout=timeout)
+            outs[i] = out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+    return [o.decode(errors="replace") for o in outs]
+
+
+@pytest.mark.fault
+def test_kill_one_of_three_survivors_bit_identical_to_oracle(tmp_path):
+    """3 OS processes, rank 1 killed (os._exit(137), kill -9 semantics)
+    at its 2nd pass boundary: the coordinator reaps it by heartbeat
+    timeout, the epoch bumps, the 2 survivors re-derive the part
+    assignment over the shrunken membership, re-run ONLY the dead
+    rank's unjournaled parts, and assemble output bit-identical to the
+    single-process oracle — served from the shared durable journal."""
+    left, right = _inputs(7)
+    base, _ = _run(left, right)
+    order = np.argsort(base["l_k"], kind="stable")
+    expected = {k: np.asarray(v)[order] for k, v in base.items()}
+
+    coord = elastic.Coordinator(3, heartbeat_timeout_s=0.8).start()
+    try:
+        addr = f"{coord.address[0]}:{coord.address[1]}"
+        env = {r: _worker_env(tmp_path) for r in range(3)}
+        env[1]["CYLON_TPU_FAULT_PLAN"] = "elastic.pass.r1@2=rank_kill"
+        procs = _spawn_workers(tmp_path, addr, 3, env)
+        outs = _communicate_all(procs)
+        assert procs[1].returncode == 137, (procs[1].returncode,
+                                            outs[1][-2000:])
+        for r in (0, 2):
+            assert procs[r].returncode == 0, (r, outs[r][-3000:])
+            got = dict(np.load(tmp_path / f"out_r{r}.npz",
+                               allow_pickle=True))
+            _assert_bit_identical(got, expected)
+            stats = json.loads((tmp_path / f"stats_r{r}.json").read_text())
+            # the final assembly is served ENTIRELY from the journal
+            assert stats["passes_skipped"] == N_PASSES
+            # the gang shrank at least once and the dead rank is gone
+            # (the other survivor's clean leave may have bumped the
+            # epoch further by stats-write time)
+            assert stats["epoch"] >= 1
+            assert 1 not in stats["members"] and r in stats["members"]
+        # the coordinator's ledger shows the loss was a heartbeat reap
+        # (survivors left cleanly afterwards)
+        assert coord._dead[1] == "heartbeat timeout"
+        assert coord._dead[0] == "left" and coord._dead[2] == "left"
+    finally:
+        coord.stop()
+
+
+@pytest.mark.fault
+def test_coordinator_death_mid_run_fails_workers_clean(tmp_path):
+    """Coordinator dies while 2 workers run: every worker must fail
+    CLEAN with the classified CoordinatorLost status (exit 3), never
+    hang — bounded by the communicate timeout + finally-kill."""
+    coord = elastic.Coordinator(2, heartbeat_timeout_s=0.8).start()
+    procs = None
+    try:
+        addr = f"{coord.address[0]}:{coord.address[1]}"
+        env = {r: _worker_env(tmp_path) for r in range(2)}
+        procs = _spawn_workers(tmp_path, addr, 2, env)
+        # wait for formation (both joined), then die mid-run: the
+        # workers are still importing jax / compiling their first pass
+        deadline = time.monotonic() + 60
+        while len(coord.view().members) < 2:
+            if time.monotonic() > deadline:
+                raise AssertionError("gang never formed")
+            time.sleep(0.05)
+        time.sleep(0.2)
+        coord.stop()
+        outs = _communicate_all(procs, timeout=120)
+        for r in (0, 1):
+            assert procs[r].returncode == 3, (r, procs[r].returncode,
+                                              outs[r][-3000:])
+            assert "coordinator lost" in outs[r]
+    finally:
+        if procs is not None:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        coord.stop()

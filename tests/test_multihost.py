@@ -9,35 +9,81 @@ import socket
 import subprocess
 import sys
 
+import jax
 import pytest
 
 pytestmark = pytest.mark.slow
 
+# Upstream gap, re-checked against the 0.4.37/0.4.36 pin (PR 6): on
+# jax 0.4.x the CPU PJRT client has no multi-process computations.
+# jax.distributed.initialize() itself SUCCEEDS and jax.process_count()
+# reports 2, but the first cross-process op — device_put of globally
+# replicated data, which routes through multihost_utils.assert_equal ->
+# broadcast_one_to_all -> a jitted psum over both processes — raises
+# `XlaRuntimeError: INVALID_ARGUMENT: Multiprocess computations aren't
+# implemented on the CPU backend.` (jax/_src/dispatch.py
+# _device_put_sharding_impl).  Newer jaxlibs grow a cross-host CPU
+# collective transport, so this gate is PIN-KEYED: bumping the pin in
+# tools/full_tree_cold.sh should re-run this test, not trust this skip.
+_CPU_MULTIPROCESS_BROKEN = jax.__version__.startswith("0.4.")
+_SKIP_REASON = (
+    "jax 0.4.x CPU backend: 'Multiprocess computations aren't implemented "
+    "on the CPU backend' — initialize() succeeds but the first "
+    "cross-process device_put/psum raises XlaRuntimeError INVALID_ARGUMENT "
+    "(re-check on any jax pin bump; cylon_tpu/elastic.py is the "
+    "multi-process path that DOES run on this pin: one local mesh per "
+    "process + the shared durable journal)")
+
+# worker exit code for a coordinator-port bind race (EX_TEMPFAIL): the
+# parent retries the whole gang on a fresh port
+BIND_RACE_RC = 75
+
 
 def _free_port() -> int:
+    # NOTE: inherently TOCTOU — the port is free only until this socket
+    # closes.  jax.distributed needs to bind the port itself, so the
+    # reservation cannot be held; the worker converts a lost race into
+    # BIND_RACE_RC and the test retries on a fresh port (the elastic
+    # control plane avoids the race entirely: its coordinator binds
+    # port 0 and the listening socket IS the reservation, net/control.py)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
+@pytest.mark.skipif(_CPU_MULTIPROCESS_BROKEN, reason=_SKIP_REASON)
 def test_two_process_distributed_join():
-    port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     env = {k: v for k, v in os.environ.items()
            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(pid), "2", str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
-        for pid in range(2)]
-    outs = []
-    for p in procs:
+    for attempt in range(3):
+        port = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+            for pid in range(2)]
+        outs = ["", ""]
+        timed_out = False
         try:
-            out, _ = p.communicate(timeout=540)
-        except subprocess.TimeoutExpired:
+            for i, p in enumerate(procs):
+                try:
+                    out, _ = p.communicate(timeout=540)
+                    outs[i] = out.decode()
+                except subprocess.TimeoutExpired:
+                    # a ONE-SIDED bind race hangs the other worker (it
+                    # connects to the foreign listener): kill the gang
+                    # and let the rc-75 check below decide retry vs fail
+                    timed_out = True
+        finally:
+            # a hung/raced worker must never leak past the suite timeout
             for q in procs:
-                q.kill()
-            raise
-        outs.append(out.decode())
+                if q.poll() is None:
+                    q.kill()
+                    q.wait(timeout=30)
+        if any(p.returncode == BIND_RACE_RC for p in procs) and attempt < 2:
+            continue  # lost the port race to another process: fresh port
+        assert not timed_out, "worker hung without a bind-race marker"
+        break
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} rc={p.returncode}:\n{out[-3000:]}"
         assert f"proc {pid}/2 OK" in out, out[-3000:]

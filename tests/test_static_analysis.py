@@ -254,6 +254,58 @@ def test_cy105_swallowed_exceptions(tmp_path):
     assert "bare" in found[0].msg
 
 
+def _scan_elastic(tmp_path, src):
+    """CY106 fixtures must live at cylon_tpu/elastic.py for the module
+    name to resolve to the elastic recovery namespace."""
+    d = tmp_path / "cylon_tpu"
+    d.mkdir(exist_ok=True)
+    p = d / "elastic.py"
+    p.write_text(textwrap.dedent(src))
+    return astlint.scan_paths([str(p)])
+
+
+def test_cy106_unguarded_collective_on_recovery_path(tmp_path):
+    found = _scan_elastic(tmp_path, """\
+        import jax
+
+        def _reform_mesh(x):
+            return jax.lax.psum(x, "p")
+
+        def elastic_resume(agent, x):
+            return _reform_mesh(x)
+        """)
+    assert _rules_at(found) == [("CY106", 6)]
+    assert "psum" in found[0].msg and "epoch guard" in found[0].msg
+
+
+def test_cy106_guarded_recovery_path_is_clean(tmp_path):
+    found = _scan_elastic(tmp_path, """\
+        import jax
+
+        def _reform_mesh(x):
+            return jax.lax.psum(x, "p")
+
+        def elastic_resume(agent, epoch, x):
+            agent.ensure_epoch(epoch)
+            return _reform_mesh(x)
+
+        def elastic_no_collectives(agent):
+            return agent.view()
+        """)
+    assert found == []
+
+
+def test_cy106_only_fires_in_the_elastic_module(tmp_path):
+    # the same shape outside cylon_tpu.elastic is not a recovery path
+    found = _scan(tmp_path, """\
+        import jax
+
+        def elastic_resume(x):
+            return jax.lax.psum(x, "p")
+        """)
+    assert "CY106" not in {f.rule for f in found}
+
+
 def test_cy001_suppression_requires_justification(tmp_path):
     # no justification: the suppression itself is the finding (and does
     # not silence the underlying rule)

@@ -108,6 +108,58 @@ if [ $rc -ne 0 ]; then
   echo "crash-resume smoke failed (rc=$rc); fix durable journaling before the full tree" >&2
   exit $rc
 fi
+# elastic kill-one-resume smoke (ISSUE-6): a 2-process gang with rank 1
+# killed (rank_kill = os._exit(137)) at its first pass boundary must
+# shrink to the survivor, which finishes the run and assembles the full
+# result from the shared journal — catches a membership/journal
+# regression in ~30 s, before the full tree runs
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import json, os, subprocess, sys, tempfile
+
+sys.path.insert(0, os.getcwd())
+from cylon_tpu import elastic
+
+td = tempfile.mkdtemp(prefix="cylon_elastic_smoke.")
+coord = elastic.Coordinator(2, heartbeat_timeout_s=0.8).start()
+addr = f"{coord.address[0]}:{coord.address[1]}"
+base_env = {k: v for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS",
+                         "CYLON_TPU_FAULT_PLAN", "CYLON_TPU_DURABLE_DIR")}
+base_env.update(CYLON_TPU_DURABLE_DIR=os.path.join(td, "journal"),
+                CYLON_TPU_HEARTBEAT_S="0.1",
+                CYLON_TPU_HEARTBEAT_TIMEOUT_S="0.8")
+procs = []
+for r in range(2):
+    env = dict(base_env)
+    if r == 1:
+        env["CYLON_TPU_FAULT_PLAN"] = "elastic.pass.r1@1=rank_kill"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "tests.elastic_worker", str(r), "2", addr,
+         os.path.join(td, f"out_r{r}.npz"),
+         os.path.join(td, f"stats_r{r}.json")], env=env))
+try:
+    for p in procs:
+        p.wait(timeout=240)
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    coord.stop()
+assert procs[1].returncode == 137, procs[1].returncode
+assert procs[0].returncode == 0, procs[0].returncode
+stats = json.load(open(os.path.join(td, "stats_r0.json")))
+assert stats["passes_skipped"] == stats["passes"], stats
+assert stats["epoch"] >= 1 and stats["members"] == [0], stats
+print(f"elastic kill-one-resume smoke ok: survivor assembled "
+      f"{stats['passes']} journaled passes at epoch {stats['epoch']}")
+import shutil; shutil.rmtree(td, ignore_errors=True)
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "elastic kill-one-resume smoke failed (rc=$rc); fix elastic membership before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

@@ -153,6 +153,15 @@ def print_report(trace_path: str, metrics_path: "str | None",
         if "hbm.live_bytes" in g:
             print(f"  hbm watermark bytes        "
                   f"{int(g['hbm.live_bytes']):>12}")
+        if "elastic.epoch" in g or any(k.startswith("elastic.")
+                                       for k in c):
+            # elastic-membership summary: how many times the gang shrank
+            # and how often this rank re-derived its slice
+            print(f"  membership epoch           "
+                  f"{int(g.get('elastic.epoch', 0)):>12}")
+            print(f"  ranks lost / resumes       "
+                  f"{int(c.get('elastic.rank_lost', 0))}/"
+                  f"{int(c.get('elastic.resume', 0))}")
 
 
 def main(argv=None) -> int:
