@@ -37,6 +37,8 @@ RULES: Dict[str, str] = {
     "CY105": "swallowed exception classification",
     "CY106": "collective reachable from an elastic recovery path without "
              "an epoch guard",
+    "CY107": "blocking device call reachable from the serve "
+             "admission/scheduler control path",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
 }
@@ -60,6 +62,19 @@ ELASTIC_ROOT_PREFIX = "elastic_"
 #: calls that count as an epoch guard on a recovery path: the agent's
 #: membership check, or an engine-level guard hook
 EPOCH_GUARD_NAMES = frozenset({"ensure_epoch", "epoch_guard"})
+
+#: the serving package and its control-path roots, for CY107: admission,
+#: shedding, cancellation and dispatch DECISIONS must stay device-free —
+#: a wedged device may delay results, never admission or drain.  Roots
+#: are matched by bare function name (exact, or one of the prefixes).
+SERVE_MODULE_PREFIX = "cylon_tpu.serve"
+SERVE_CONTROL_ROOTS = frozenset({"submit", "cancel", "drain"})
+SERVE_CONTROL_PREFIXES = ("_dispatch", "_admit", "_shed", "_cancel")
+
+#: call names (final identifier) that block the calling thread on device
+#: work, for CY107 reachability
+BLOCKING_DEVICE_NAMES = frozenset({
+    "block_until_ready", "device_get", "device_put", "to_numpy"})
 
 _SUPPRESS_RE = re.compile(
     r"#\s*cylint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--\s*(\S.*))?\s*$")
@@ -789,6 +804,52 @@ def _check_elastic_guards(prog: _Program, mod: _Module) -> None:
                 "recovery path"))
 
 
+def _check_serve_blocking(prog: _Program, mod: _Module) -> None:
+    """CY107: a serve-layer control-path root (``submit`` / ``cancel`` /
+    ``drain`` / ``_dispatch*`` / ``_admit*`` / ``_shed*`` / ``_cancel*``
+    in any module under ``cylon_tpu.serve``) from which a blocking
+    device call is reachable.
+
+    The invariant: admission, shedding, cancellation and dispatch
+    decisions run on caller threads and the scheduler tick — if any of
+    them waits on the device, a wedged query stops the service from
+    SHEDDING, which is the exact hang the serving layer exists to
+    prevent.  Device work belongs in the executor (``_run_ticket``)
+    only.  Reachability resolves ``self.X`` calls against same-module
+    functions so class methods participate in the walk."""
+    if not mod.name.startswith(SERVE_MODULE_PREFIX):
+        return
+    for f in mod.funcs.values():
+        name = f.qual.rsplit(".", 1)[-1]
+        if not (name in SERVE_CONTROL_ROOTS
+                or name.startswith(SERVE_CONTROL_PREFIXES)):
+            continue
+        seen: Set[str] = set()
+        stack = [f.qual]
+        hit: Set[str] = set()
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            fn = prog.by_qual.get(q)
+            if fn is None:
+                continue
+            hit |= fn.call_finals & BLOCKING_DEVICE_NAMES
+            for c in fn.calls:
+                if c.startswith(("self.", "cls.")):
+                    c = f"{fn.module}.{c.split('.', 1)[1]}"
+                stack.append(c)
+        if hit:
+            mod.findings.append(Finding(
+                "CY107", mod.path, f.lineno,
+                f"serve control path `{name}` reaches blocking device "
+                f"call(s) {', '.join(sorted(hit))} — a wedged device "
+                f"would stop the service from admitting or shedding",
+                "move the device work into the executor (_run_ticket); "
+                "admission/dispatch decisions must be host-only"))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -823,6 +884,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_retries(prog, mod)
         _check_plan_keys(prog, mod)
         _check_elastic_guards(prog, mod)
+        _check_serve_blocking(prog, mod)
         for f in mod.funcs.values():
             if f.qual in traced:
                 _Taint(f, mod, mod.findings).run()

@@ -254,6 +254,47 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "deadline.fired when a pass runs past it and the pass is "
             "classified Code.Timeout (retried like a transient).  "
             "0 (default) disables."),
+    _K("CYLON_TPU_DURABLE_CAP_BYTES", "int", 0, RUNTIME,
+       accessors=("cylon_tpu.durable.cap_bytes",),
+       help="Size cap for the durable journal root: past it, whole runs "
+            "are evicted least-recently-used first (spills before the "
+            "manifest, so a half-evicted run re-executes instead of "
+            "serving a torn journal).  Shared by the serving layer's "
+            "result cache.  0 (default) = unbounded (pre-PR-7 "
+            "behavior)."),
+    _K("CYLON_TPU_SERVE_QUEUE_CAP", "int", 64, RUNTIME,
+       accessors=("cylon_tpu.serve.service.queue_cap",),
+       help="Bounded admission queue of the multi-tenant query service: "
+            "submissions past this depth are shed with "
+            "Code.ResourceExhausted + a retry-after hint, never an "
+            "unbounded wait."),
+    _K("CYLON_TPU_SERVE_TENANT_SHARE", "float", 0.5, RUNTIME,
+       accessors=("cylon_tpu.serve.service.tenant_share",),
+       help="Largest fraction of the admission queue one tenant may "
+            "occupy (flood isolation): beyond ceil(cap * share) queued "
+            "requests the TENANT is shed while others keep admitting."),
+    _K("CYLON_TPU_SERVE_HBM_BUDGET_BYTES", "int", 0, RUNTIME,
+       accessors=("cylon_tpu.serve.service.hbm_budget_bytes",),
+       help="Per-tenant HBM admission budget: a request whose input-size "
+            "estimate (plus the live hbm.live_bytes watermark) exceeds "
+            "it is shed with Code.ResourceExhausted at admission, "
+            "before any device allocation.  0 (default) disables."),
+    _K("CYLON_TPU_SERVE_DEADLINE_S", "float", 0.0, RUNTIME,
+       accessors=("cylon_tpu.serve.service.default_deadline_s",),
+       help="Default per-REQUEST wall-clock budget in the query service "
+            "(per-tenant overridable): the Code.Timeout watchdog arms "
+            "over the whole run and the scheduler stops it at the next "
+            "pass boundary.  0 (default) disables."),
+    _K("CYLON_TPU_SERVE_QUARANTINE_AFTER", "int", 3, RUNTIME,
+       accessors=("cylon_tpu.serve.service.tenant_quarantine_after",),
+       help="Per-TENANT quarantine: a tenant whose requests fail this "
+            "many consecutive times is shed (Code.Unavailable + "
+            "retry-after) for CYLON_TPU_SERVE_QUARANTINE_S, so one "
+            "poison tenant cannot starve the rest.  0 disables."),
+    _K("CYLON_TPU_SERVE_QUARANTINE_S", "float", 30.0, RUNTIME,
+       accessors=("cylon_tpu.serve.service.tenant_quarantine_s",),
+       help="How long a quarantined tenant stays shed before its failure "
+            "streak resets."),
     _K("CYLON_TPU_QUARANTINE_AFTER", "int", 0, RUNTIME,
        accessors=("cylon_tpu.durable.quarantine_after",),
        help="Poison-pass quarantine: a part failing with the same "

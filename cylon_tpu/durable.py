@@ -94,6 +94,12 @@ def quarantine_after() -> int:
     return max(0, int(config.knob("CYLON_TPU_QUARANTINE_AFTER")))
 
 
+def cap_bytes() -> int:
+    """Journal size cap (``CYLON_TPU_DURABLE_CAP_BYTES``); 0 (default)
+    means unbounded — the pre-PR-7 grow-without-bound behavior."""
+    return max(0, int(config.knob("CYLON_TPU_DURABLE_CAP_BYTES")))
+
+
 # ---------------------------------------------------------------------------
 # run fingerprinting
 # ---------------------------------------------------------------------------
@@ -230,6 +236,7 @@ class RunJournal:
         self._quarantined: List[dict] = []
         self._last_committed: Optional[str] = None
         self._spill_disabled = False
+        self._done: Optional[dict] = None
 
     # -- open / manifest replay -----------------------------------------
 
@@ -281,6 +288,8 @@ class RunJournal:
                                       int(entry["part"]))] = entry
                     elif kind == "quarantine":
                         self._quarantined.append(entry)
+                    elif kind == "done":
+                        self._done = entry
         if header is not None and header.get("fingerprint") != self.fingerprint:
             # the dir is named by the fingerprint, so this means tampering
             # or a collision — stale spills must never serve another run
@@ -306,6 +315,11 @@ class RunJournal:
                 log.warning("durable: manifest header write failed (%s: "
                             "%s); journaling disabled for this run",
                             type(e).__name__, e)
+        # LRU clock for the size-cap GC: every open (a fresh run, a
+        # resume, a cache serve) freshens the manifest mtime, so eviction
+        # order is least-recently-USED, not least-recently-written
+        with contextlib.suppress(OSError):
+            os.utime(path)
         if self._passes:
             log.info("durable: resuming run %s from %d journaled passes",
                      self.fingerprint[:12], len(self._passes))
@@ -443,11 +457,149 @@ class RunJournal:
         except OSError as e:
             log.warning("durable: quarantine record failed: %s", e)
 
+    # -- run completion (the result-cache contract) -----------------------
+
+    def record_done(self, passes: int, rows: int) -> None:
+        """Mark the run complete: every pass the plan needed is journaled
+        (the streaming loop finished with nothing remaining and nothing
+        quarantined).  A complete journal IS a result-cache entry — a
+        repeated fingerprint replays entirely from spill.  Best-effort
+        like every other write here."""
+        if self._spill_disabled or self._done is not None:
+            return
+        entry = {"kind": "done", "passes": int(passes), "rows": int(rows)}
+        try:
+            self._append(entry)
+        except OSError as e:
+            log.warning("durable: done record failed: %s", e)
+            return
+        self._done = entry
+
+    def is_complete(self) -> bool:
+        """True when a prior invocation recorded the run done — the
+        serving layer's cheap cache-hit probe (spill checksums are still
+        verified pass-by-pass at load time)."""
+        return self._done is not None
+
 
 def open_run(fingerprint: str, op: str, world: Optional[int] = None,
              epoch: Optional[int] = None) -> Optional[RunJournal]:
     """Module-level convenience over :meth:`RunJournal.open_run`."""
     return RunJournal.open_run(fingerprint, op, world=world, epoch=epoch)
+
+
+def scan_runs(root: Optional[str] = None) -> List[dict]:
+    """Inventory of the journal root for GC/cache introspection: one dict
+    per run dir — ``fingerprint``, ``bytes`` (all files), ``mtime`` (the
+    manifest's, the LRU clock), ``complete`` (a ``done`` manifest record
+    exists) — sorted least-recently-used first.  Pure filesystem walk;
+    unreadable entries are skipped (a racing eviction is not an error)."""
+    root = durable_dir() if root is None else root
+    out: List[dict] = []
+    if not root or not os.path.isdir(root):
+        return out
+    for name in sorted(os.listdir(root)):
+        d = os.path.join(root, name)
+        manifest = os.path.join(d, MANIFEST)
+        if not os.path.isdir(d):
+            continue
+        total = 0
+        complete = False
+        try:
+            for fn in os.listdir(d):
+                with contextlib.suppress(OSError):
+                    total += os.path.getsize(os.path.join(d, fn))
+            mtime = os.path.getmtime(manifest) if os.path.exists(manifest) \
+                else os.path.getmtime(d)
+            if os.path.exists(manifest):
+                with open(manifest, "r", encoding="utf-8") as fh:
+                    for raw in fh:
+                        try:
+                            if json.loads(raw).get("kind") == "done":
+                                complete = True
+                        except ValueError:
+                            break
+        except OSError:
+            continue
+        out.append({"fingerprint": name, "dir": d, "bytes": total,
+                    "mtime": mtime, "complete": complete})
+    out.sort(key=lambda r: (r["mtime"], r["fingerprint"]))
+    return out
+
+
+def _evict_run_dir(d: str) -> None:
+    """Remove one run dir MANIFEST-LAST: spills go first, the manifest
+    after them, the dir itself at the end.  A crash (or a concurrent
+    reader) at any point sees either a manifest whose spills fail their
+    checksums — so the affected passes simply re-execute — or no
+    manifest at all; never a torn journal served as a result."""
+    names = []
+    with contextlib.suppress(OSError):
+        names = os.listdir(d)
+    for fn in sorted(names):
+        if fn != MANIFEST:
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(d, fn))
+    with contextlib.suppress(OSError):
+        os.remove(os.path.join(d, MANIFEST))
+    with contextlib.suppress(OSError):
+        os.rmdir(d)
+
+
+def gc_journal(root: Optional[str] = None,
+               cap: Optional[int] = None) -> Tuple[int, int]:
+    """Size-cap LRU eviction over the journal root: whole runs are
+    evicted least-recently-used first until total bytes fit under
+    ``CYLON_TPU_DURABLE_CAP_BYTES`` (or ``cap``).  Returns
+    ``(runs_evicted, bytes_freed)``; (0, 0) when no cap is set, the root
+    is unused, or everything already fits.  The currently-open journal
+    (an in-flight run) is never evicted from under its own writer."""
+    root = durable_dir() if root is None else root
+    cap = cap_bytes() if cap is None else max(0, int(cap))
+    if not root or cap <= 0:
+        return 0, 0
+    runs = scan_runs(root)
+    total = sum(r["bytes"] for r in runs)
+    if total <= cap:
+        return 0, 0
+    live = _LAST_JOURNAL.dir if _LAST_JOURNAL is not None else None
+    evicted = 0
+    freed = 0
+    for r in runs:
+        if total - freed <= cap:
+            break
+        if r["dir"] == live:
+            continue
+        _evict_run_dir(r["dir"])
+        evicted += 1
+        freed += r["bytes"]
+        obs_spans.instant("durable.gc_evict", fingerprint=r["fingerprint"],
+                          bytes=r["bytes"], complete=r["complete"])
+    if evicted:
+        obs_metrics.counter_add("durable.gc_runs_evicted", evicted)
+        obs_metrics.counter_add("durable.gc_bytes_freed", freed)
+        log.info("durable: GC evicted %d run(s), %d bytes (cap %d)",
+                 evicted, freed, cap)
+    return evicted, freed
+
+
+def _evict_last_run_spills() -> None:
+    """Test hook behind the ``cache_evict_race`` fault kind: delete the
+    most recently opened run's SPILL files while keeping its manifest —
+    the exact window a concurrent GC eviction exposes to a reader that
+    already replayed the manifest.  Every load then fails (missing
+    spill) and the pass re-executes; the run must still complete."""
+    j = _LAST_JOURNAL
+    if j is None or not os.path.isdir(j.dir):
+        return
+    n = 0
+    for fn in sorted(os.listdir(j.dir)):
+        if fn != MANIFEST:
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(j.dir, fn))
+                n += 1
+    log.warning("durable: injected evict race removed %d spill(s) under %s",
+                n, j.dir)
 
 
 def _corrupt_last_spill() -> None:

@@ -484,6 +484,22 @@ def _notify_progress(done, n_passes, total, secs) -> None:
                       f"progress reporting disabled", RuntimeWarning)
 
 
+def _compose_guards(*guards):
+    """One pass-boundary guard from several optional ones (elastic epoch
+    checks, serve-layer cancellation/deadline) — None when all are None,
+    the single guard unwrapped, else a caller running them in order."""
+    gs = [g for g in guards if g is not None]
+    if not gs:
+        return None
+    if len(gs) == 1:
+        return gs[0]
+
+    def guard():
+        for g in gs:
+            g()
+    return guard
+
+
 class _RefinablePlan:
     """Key-domain pass plan that can subdivide its REMAINING parts when a
     pass exceeds device memory.
@@ -619,10 +635,11 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
     Elastic execution (PR 6): ``parts`` restricts the stream to a subset
     of the plan's level-0 part ids (this process's slice of an elastic
     gang; part ids stay GLOBAL so the shared journal is coherent across
-    ranks and world sizes), and ``pass_guard`` is called before every
-    pass — `elastic.EpochChanged` / `elastic.CoordinatorLost` raised
-    there carry non-retryable codes, so they abandon in-flight work and
-    propagate straight to the elastic loop instead of burning retries.
+    ranks and world sizes).  ``pass_guard`` is called before every pass;
+    ANY exception it raises (elastic `EpochChanged`/`CoordinatorLost`,
+    the serve layer's cancellation or request-budget Timeout) abandons
+    the stream and propagates unchanged — guard raises never enter the
+    retry/split/quarantine machinery, whatever their code.
 
     Poison-pass quarantine (``CYLON_TPU_QUARANTINE_AFTER`` = N > 0): a
     head part failing with the SAME classified code N consecutive times
@@ -836,15 +853,23 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
             t_run0 = time.perf_counter()
         cursor = 0
         cur = fut = nxt = None
+        guard_exc = None
         try:
             nxt = chunk(remaining[0]) if prefetch else None
             while cursor < len(remaining):
                 if pass_guard is not None:
-                    # elastic epoch/membership guard: EpochChanged /
-                    # CoordinatorLost carry non-retryable codes, so
-                    # recover() propagates them — in-flight work is
-                    # abandoned, never retried into a changed world
-                    pass_guard()
+                    # a guard raise (elastic EpochChanged/CoordinatorLost,
+                    # serve cancellation or request-budget Timeout)
+                    # ABANDONS the stream unconditionally — it never
+                    # enters recover(), so a retryable-coded Timeout from
+                    # a request budget cannot burn retries or quarantine
+                    # healthy parts, and in-flight work is never retried
+                    # into a changed world
+                    try:
+                        pass_guard()
+                    except Exception as ge:
+                        guard_exc = ge
+                        raise
                 part = remaining[cursor]
                 if journal is not None:
                     hit = journal.load_pass(level, part)
@@ -920,6 +945,8 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
             cur = fut = nxt = None
             chunk = prog = fetch = ex = None
             remaining = remaining[cursor:]  # completed frames are kept
+            if guard_exc is e:
+                raise
             recover(e)
     if t_plan is None:
         t_plan = time.perf_counter() - t0
@@ -927,7 +954,7 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
 
 
 def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0, *,
-                policy=None, stats=None, journal=None):
+                policy=None, stats=None, journal=None, pass_guard=None):
     """Streaming loop over positional passes 0..n-1 with transient-retry
     resilience (no OOM splitting: callers on this entry — the global sort
     — emit passes in an order a hash subdivision would scramble).
@@ -945,7 +972,8 @@ def _run_passes(prog, empty_chunk, chunk, n_passes, fetch, t0, *,
         return chunk, prog, fetch
 
     return _stream_recoverable(make_exec, None, t0, policy=policy,
-                               stats=stats, journal=journal)
+                               stats=stats, journal=journal,
+                               pass_guard=pass_guard)
 
 
 def _concat_host(frames: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -964,11 +992,17 @@ def chunked_join(left, right, *, on=None, left_on=None, right_on=None,
                  how: str = "inner", passes: int = 4, algo: str = "sort",
                  mode: str = "auto", ctx=None, prefetch: bool = True,
                  left_prefix: str = "l_", right_prefix: str = "r_",
-                 elastic=None):
+                 elastic=None, pass_guard=None):
     """Out-of-core join over host frames (pandas/dict/Table): the key
     domain is split into ``passes`` parts, each part joined on device by
     one shared compiled program, outputs concatenated on the host.  All
     four join types are exact because parts partition BOTH sides by key.
+
+    ``pass_guard`` (serving layer): called before every pass; raising a
+    non-retryable `CylonError` there (Cancelled, Timeout past a request
+    budget) stops the stream at the next pass boundary — the in-flight
+    pass finishes (and journals) first, so cancellation never loses
+    completed work.
 
     Returns (dict of host columns keyed by joined names, stats)."""
     return _chunked_engine(left, right, on=on, left_on=left_on,
@@ -976,7 +1010,8 @@ def chunked_join(left, right, *, on=None, left_on=None, right_on=None,
                            agg=None, passes=passes, algo=algo, ddof=0,
                            mode=mode, ctx=ctx, prefetch=prefetch,
                            left_prefix=left_prefix,
-                           right_prefix=right_prefix, elastic=elastic)
+                           right_prefix=right_prefix, elastic=elastic,
+                           pass_guard=pass_guard)
 
 
 def chunked_join_groupby_tables(left, right, *, on=None, left_on=None,
@@ -984,7 +1019,8 @@ def chunked_join_groupby_tables(left, right, *, on=None, left_on=None,
                                 group_by, agg: Dict, passes: int = 4,
                                 algo: str = "sort", ddof: int = 0,
                                 mode: str = "auto", ctx=None,
-                                prefetch: bool = True, elastic=None):
+                                prefetch: bool = True, elastic=None,
+                                pass_guard=None):
     """Out-of-core join + group-by over host frames.  ``group_by`` and
     ``agg`` use POST-JOIN column names (collisions prefixed l_/r_, as
     Table.join names them).  When the group keys pin down the
@@ -1000,13 +1036,13 @@ def chunked_join_groupby_tables(left, right, *, on=None, left_on=None,
                            right_on=right_on, how=how, group_by=group_by,
                            agg=agg, passes=passes, algo=algo, ddof=ddof,
                            mode=mode, ctx=ctx, prefetch=prefetch,
-                           elastic=elastic)
+                           elastic=elastic, pass_guard=pass_guard)
 
 
 def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
                     agg, passes, algo, ddof, mode, ctx, prefetch,
                     left_prefix: str = "l_", right_prefix: str = "r_",
-                    elastic=None):
+                    elastic=None, pass_guard=None):
     t_plan0 = time.perf_counter()
     names_l, arrs_l = _as_host_frame(left)
     names_r, arrs_r = _as_host_frame(right)
@@ -1079,7 +1115,8 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
         return _chunked_distributed(
             arrs_l, names_l, arrs_r, names_r, lon, ron, cfg, joined,
             pid_l, pid_r, n_passes, counts_l, counts_r, gb_names, aggs_req,
-            final_per_pass, agg, ddof, ctx, mode_used, t_plan0)
+            final_per_pass, agg, ddof, ctx, mode_used, t_plan0,
+            pass_guard=pass_guard)
 
     # -- the one compiled per-pass program (per refinement level) --------
     nk = len(lon)
@@ -1204,7 +1241,13 @@ def _chunked_engine(left, right, *, on, left_on, right_on, how, group_by,
         make_exec, plan, t_plan0, policy=policy, stats=stats,
         prefetch=prefetch, journal=journal,
         parts=None if elastic is None else elastic.parts,
-        pass_guard=None if elastic is None else elastic.guard)
+        pass_guard=_compose_guards(
+            None if elastic is None else elastic.guard, pass_guard))
+    if journal is not None and not stats.get("quarantined"):
+        # every pass the plan needed is journaled: the run is a complete
+        # result-cache entry, and the cap GC may now reclaim older runs
+        journal.record_done(len(frames), total)
+        durable.gc_journal()
     result = _concat_host(frames)
     if gb_names is not None and not final_per_pass:
         result, total = _combine_partials(result, gb_names, aggs_req,
@@ -1297,7 +1340,7 @@ def _combine_partials(partial_result, gb_names, aggs_req, arrs_l, arrs_r,
 def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
                          joined, pid_l, pid_r, n_passes, counts_l, counts_r,
                          gb_names, aggs_req, final_per_pass, agg, ddof, ctx,
-                         mode_used, t_plan0):
+                         mode_used, t_plan0, pass_guard=None):
     """Every key-domain pass sharded over ``ctx``'s mesh via the public
     distributed operators — total capacity is passes x mesh-HBM (the
     composition of the reference's rank scaling, docs/docs/arch.md:146-162,
@@ -1349,6 +1392,11 @@ def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
         return g.to_numpy(), g.row_count
 
     for p in range(n_passes):
+        if pass_guard is not None:
+            # serve-layer cancellation/deadline: stop at the next pass
+            # boundary — completed frames were already fetched, nothing
+            # in-flight is abandoned mid-collective
+            pass_guard()
         # transient (comm/deadline) failures retry the PASS, not the whole
         # stream: completed frames are the checkpoint
         (frame, n), attempts = resilience.retry_call(
@@ -1384,7 +1432,8 @@ def _chunked_distributed(arrs_l, names_l, arrs_r, names_r, lon, ron, cfg,
 # ---------------------------------------------------------------------------
 
 def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
-                    mode: str = "auto", ctx=None, elastic=None):
+                    mode: str = "auto", ctx=None, elastic=None,
+                    pass_guard=None):
     """Out-of-core group-by over one host frame: the key domain is
     partitioned on the GROUP columns themselves, so every pass's
     group-by is final (a group never spans passes) and the results just
@@ -1424,6 +1473,8 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
         t_plan = time.perf_counter() - t0
         t_run0 = time.perf_counter()
         for p in range(n_passes):
+            if pass_guard is not None:
+                pass_guard()
             sel = pid == p
             t = Table.from_numpy(names, [np.asarray(arrs[n])[sel]
                                          for n in names], ctx=ctx,
@@ -1475,7 +1526,11 @@ def chunked_groupby(data, by, agg: Dict, *, passes: int = 4, ddof: int = 0,
         t_plan, t_run0, frames, total = _stream_recoverable(
             make_exec, plan, t0, stats=extra, journal=journal,
             parts=None if elastic is None else elastic.parts,
-            pass_guard=None if elastic is None else elastic.guard)
+            pass_guard=_compose_guards(
+                None if elastic is None else elastic.guard, pass_guard))
+        if journal is not None and not extra.get("quarantined"):
+            journal.record_done(len(frames), total)
+            durable.gc_journal()
     result = _concat_host(frames)
     t_run = time.perf_counter() - t_run0
     stats = {"passes": n_passes, "mode": mode_used, "world": world,
@@ -1688,7 +1743,7 @@ def chunked_unique(data, columns=None, *, passes: int = 4,
 
 
 def chunked_sort(data, by, *, ascending=True, nulls_first: bool = True,
-                 passes: int = 4, ctx=None):
+                 passes: int = 4, ctx=None, pass_guard=None):
     """Out-of-core GLOBAL sort of one host frame: range-partition on the
     first sort column's order-preserving prefix (equal keys co-locate,
     ranges are contiguous in key order), sort each pass on device, and
@@ -1732,6 +1787,8 @@ def chunked_sort(data, by, *, ascending=True, nulls_first: bool = True,
         t_plan = time.perf_counter() - t0
         t_run0 = time.perf_counter()
         for p in emit_order:
+            if pass_guard is not None:
+                pass_guard()
             sel = pid == p
             t = Table.from_numpy(names, [np.asarray(arrs[n])[sel]
                                          for n in names], ctx=ctx,
@@ -1768,14 +1825,18 @@ def chunked_sort(data, by, *, ascending=True, nulls_first: bool = True,
         extra = {}
         t_plan, t_run0, frames, total = _run_passes(
             prog, build.empty_chunk, lambda p: build.chunk(emit_order[p]),
-            n_passes, fetch, t0, stats=extra, journal=journal)
+            n_passes, fetch, t0, stats=extra, journal=journal,
+            pass_guard=pass_guard)
+        if journal is not None and not extra.get("quarantined"):
+            journal.record_done(len(frames), total)
+            durable.gc_journal()
     result = _concat_host(frames)
     t_run = time.perf_counter() - t_run0
     stats = {"passes": n_passes, "mode": "range", "world": world,
              "rows": total, "plan_seconds": t_plan, "run_seconds": t_run,
              "total_seconds": t_plan + t_run}
     if world == 1:
-        for k in ("passes_skipped", "quarantined", "retries"):
+        for k in ("passes_skipped", "quarantined", "retries", "parts_run"):
             if k in extra:
                 stats[k] = extra[k]
     return result, stats

@@ -167,6 +167,19 @@ _KIND_MESSAGES = {
                        "(hit {hit}): network error"),
     "coordinator_loss": ("UNAVAILABLE: injected coordinator loss at {site} "
                          "(hit {hit}): connection closed"),
+    # serving kinds (PR 7): `tenant_flood` raises at the admission probe
+    # (serve.admit) — the service converts it into a classified shed, the
+    # deterministic stand-in for an admission resource check tripping;
+    # `shed` raises at the dispatch probe (serve.dispatch) so a QUEUED
+    # request sheds instead of running; `cache_evict_race` deletes the
+    # last-opened journal's spill files while KEEPING the manifest — the
+    # GC-eviction-races-a-reader window the result cache must survive by
+    # re-executing, never by serving a torn journal
+    "tenant_flood": ("RESOURCE_EXHAUSTED: injected tenant flood at {site} "
+                     "(hit {hit}): admission budget exceeded"),
+    "shed": ("UNAVAILABLE: injected shed at {site} (hit {hit}): "
+             "request shed under load"),
+    "cache_evict_race": "injected cache evict race at {site} (hit {hit})",
 }
 
 FAULT_KINDS = tuple(_KIND_MESSAGES)
@@ -304,6 +317,11 @@ def fault_point(site: str) -> None:
             from . import durable
 
             durable._corrupt_last_spill()
+            return
+        if kind == "cache_evict_race":
+            from . import durable
+
+            durable._evict_last_run_spills()
             return
         if kind == "hang":
             from . import durable

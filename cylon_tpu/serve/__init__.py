@@ -1,0 +1,21 @@
+"""cylon_tpu.serve — multi-tenant query serving over one mesh.
+
+The layer above single-op execution (ROADMAP item 2): a bounded-queue
+admission controller + scheduler that turns overload into a classified,
+recoverable condition (`Code.ResourceExhausted`/`Code.Unavailable` with
+retry-after hints, never a hang or an OOM), enforces per-tenant
+deadline/memory/failure budgets through the PR-1/5 substrate, and
+serves repeated queries from the durable journal as a result cache.
+"""
+from .cache import cache_bytes, contents, maybe_gc, served_from_journal
+from .service import (OPS, QueryService, TenantBudget, Ticket,
+                      default_deadline_s, hbm_budget_bytes, queue_cap,
+                      tenant_quarantine_after, tenant_quarantine_s,
+                      tenant_share)
+
+__all__ = [
+    "QueryService", "TenantBudget", "Ticket", "OPS",
+    "queue_cap", "tenant_share", "hbm_budget_bytes", "default_deadline_s",
+    "tenant_quarantine_after", "tenant_quarantine_s",
+    "served_from_journal", "contents", "cache_bytes", "maybe_gc",
+]

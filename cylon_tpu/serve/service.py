@@ -1,0 +1,626 @@
+"""Multi-tenant query service: admission control, per-tenant budgets,
+load shedding, cancellation, and graceful drain over ONE mesh.
+
+The ROADMAP's "millions of users" is many concurrent small-to-medium
+queries sharing one TPU mesh, not one giant query — and before this
+module the process had no overload story: a runaway caller could wedge
+the device queue or OOM the whole process, and every other caller died
+with it.  The reference has no serving layer at all (PAPER.md §5 — its
+unit of deployment is one MPI job per query), so this is where the TPU
+build overtakes it.  The design makes overload a *classified,
+recoverable* condition:
+
+- **admission control** — submissions pass host-side checks on the
+  CALLER's thread and either enter a BOUNDED queue or are shed
+  immediately with `Code.ResourceExhausted` / `Code.Unavailable` and a
+  ``retry_after_s`` hint (`CylonError.retry_after_s`).  Nothing ever
+  waits unboundedly: the queue cap (``CYLON_TPU_SERVE_QUEUE_CAP``), a
+  per-tenant share of it (``CYLON_TPU_SERVE_TENANT_SHARE`` — one
+  flooding tenant sheds alone while others keep admitting), and an
+  optional per-tenant HBM admission estimate
+  (``CYLON_TPU_SERVE_HBM_BUDGET_BYTES``, checked against the
+  ``hbm.live_bytes`` watermark BEFORE any device allocation) all reject
+  deterministically.
+
+- **one scheduler, one mesh** — a single daemon thread pops admitted
+  tickets and runs them serially through the chunked engine (exec.py),
+  the only execution discipline XLA's in-order device queues actually
+  honor.  Scheduling decisions (`_dispatch_next`) are device-free by
+  contract — cylint CY107 machine-checks that no blocking device call
+  is reachable from the admission/dispatch path, so a wedged device can
+  delay RESULTS but never admission or shedding.
+
+- **per-tenant budgets through the existing substrate** — deadlines arm
+  the `Code.Timeout` watchdog (durable.PassDeadline) over the whole
+  request and stop it at the next pass boundary; repeated failures
+  quarantine the TENANT (``CYLON_TPU_SERVE_QUARANTINE_AFTER`` /
+  ``_QUARANTINE_S``) the way the engine quarantines poison passes — a
+  poison tenant is shed with `Code.Unavailable` + retry-after while
+  everyone else keeps being served.
+
+- **the journal as a result cache** — with ``CYLON_TPU_DURABLE_DIR``
+  set, a repeated fingerprint (durable.py already keys op x input
+  content x knobs) replays entirely from spill: zero compiles, zero
+  device passes (``serve.cache_hit``; serve/cache.py).  The
+  ``CYLON_TPU_DURABLE_CAP_BYTES`` LRU GC bounds it.
+
+- **cancellation + graceful drain** — ``Ticket.cancel()`` removes
+  queued work (`Code.Cancelled`) or stops a running request at the next
+  pass boundary (completed passes stay journaled, so a re-submit
+  resumes); ``drain()`` sheds the queue with `Code.Unavailable` and
+  lets the in-flight request finish or journal.
+
+Everything is host-side threading + the existing engine — no new traced
+code, so the jaxpr collective-budget goldens are untouched by
+construction.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import config
+from .. import durable
+from .. import exec as exec_mod
+from .. import resilience
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..status import Code, CylonError, Status
+from . import cache as cache_mod
+
+
+# ---------------------------------------------------------------------------
+# knob accessors (registry rows in config.py::KNOBS)
+# ---------------------------------------------------------------------------
+
+def queue_cap() -> int:
+    return max(1, int(config.knob("CYLON_TPU_SERVE_QUEUE_CAP")))
+
+
+def tenant_share() -> float:
+    return min(1.0, max(0.0, float(config.knob("CYLON_TPU_SERVE_TENANT_SHARE"))))
+
+
+def hbm_budget_bytes() -> int:
+    return max(0, int(config.knob("CYLON_TPU_SERVE_HBM_BUDGET_BYTES")))
+
+
+def default_deadline_s() -> float:
+    return max(0.0, float(config.knob("CYLON_TPU_SERVE_DEADLINE_S")))
+
+
+def tenant_quarantine_after() -> int:
+    return max(0, int(config.knob("CYLON_TPU_SERVE_QUARANTINE_AFTER")))
+
+
+def tenant_quarantine_s() -> float:
+    return max(0.0, float(config.knob("CYLON_TPU_SERVE_QUARANTINE_S")))
+
+
+# the ctor's ``queue_cap=`` parameter shadows the accessor's name
+_default_queue_cap = queue_cap
+
+
+# ---------------------------------------------------------------------------
+# requests
+# ---------------------------------------------------------------------------
+
+#: ops the service admits — each maps to a chunked-engine entry point
+#: accepting ``ctx=`` and ``pass_guard=`` (the cancellation hook)
+OPS = ("join", "join_groupby", "groupby", "sort")
+
+_RUNNERS = {
+    "join": exec_mod.chunked_join,
+    "join_groupby": exec_mod.chunked_join_groupby_tables,
+    "groupby": exec_mod.chunked_groupby,
+    "sort": exec_mod.chunked_sort,
+}
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+SHED = "shed"
+
+
+@dataclass(frozen=True)
+class TenantBudget:
+    """Per-tenant overrides of the service-wide budget knobs.  None
+    inherits the knob default."""
+
+    deadline_s: Optional[float] = None    # request wall-clock budget
+    hbm_bytes: Optional[int] = None       # admission HBM estimate cap
+    max_queued: Optional[int] = None      # queued-request cap (share
+                                          # of the queue otherwise)
+
+
+class Ticket:
+    """One admitted request: a caller-side handle carrying the result
+    event, the terminal state, and the cancel signal."""
+
+    def __init__(self, service: "QueryService", tenant: str, op: str,
+                 args, kwargs):
+        self._service = service
+        self.tenant = tenant
+        self.op = op
+        self.args = args
+        self.kwargs = kwargs
+        self.state = QUEUED
+        self.result_value = None
+        self.stats: Optional[dict] = None
+        self.error: Optional[CylonError] = None
+        self.cache_hit = False
+        self.duration_s: Optional[float] = None
+        self._event = threading.Event()
+        self._cancel = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the outcome: ``(result, stats)`` on success, the
+        classified `CylonError` re-raised on failure/cancel/shed.  A
+        ``timeout`` miss raises `Code.Timeout` WITHOUT cancelling the
+        request — call :meth:`cancel` for that."""
+        if not self._event.wait(timeout):
+            raise CylonError(Code.Timeout,
+                             f"no result within {timeout}s (request "
+                             f"{self.op} for tenant {self.tenant!r} is "
+                             f"still {self.state})")
+        if self.error is not None:
+            raise self.error
+        return self.result_value, self.stats
+
+    def cancel(self) -> bool:
+        """Cancel: a queued request is removed immediately; a running one
+        stops at the next pass boundary (the in-flight pass finishes —
+        and journals — first).  False when already finished."""
+        return self._service._cancel_ticket(self)
+
+    def _finish(self, state: str, *, result=None, stats=None,
+                error: Optional[CylonError] = None) -> None:
+        self.state = state
+        self.result_value = result
+        self.stats = stats
+        self.error = error
+        self._event.set()
+
+
+# ---------------------------------------------------------------------------
+# the service
+# ---------------------------------------------------------------------------
+
+def _estimate_request_bytes(args, kwargs) -> int:
+    """Host-side HBM admission estimate: the input frames' byte size
+    times a pack factor of 2 (power-of-two chunk capacities + the join
+    output roughly double residency).  Positional AND keyword values are
+    scanned, so ``submit(t, "join", left=l, right=r)`` cannot slip past
+    the budget.  Advisory by design — the engine's OOM recovery remains
+    the backstop; this check only keeps a request that PLAINLY cannot
+    fit from ever touching the device."""
+    total = 0
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, dict):
+            for v in a.values():
+                nb = getattr(np.asarray(v), "nbytes", 0)
+                total += int(nb)
+        else:
+            nbytes = getattr(a, "nbytes", None)
+            if isinstance(nbytes, (int, np.integer)):
+                total += int(nbytes)
+    return 2 * total
+
+
+class _TenantState:
+    __slots__ = ("queued", "admitted", "served", "shed", "failed",
+                 "cancelled", "cache_hits", "streak", "quarantined_until")
+
+    def __init__(self):
+        self.queued = 0
+        self.admitted = 0
+        self.served = 0
+        self.shed = 0
+        self.failed = 0
+        self.cancelled = 0
+        self.cache_hits = 0
+        self.streak = 0              # consecutive classified failures
+        self.quarantined_until = 0.0
+
+
+class QueryService:
+    """Single-process multi-tenant query service over one mesh (``ctx``
+    = None for the local chip, or a distributed `CylonContext`).
+
+    Usage::
+
+        svc = QueryService()
+        t = svc.submit("tenant-a", "join", left, right, on="k", passes=2)
+        result, stats = t.result(timeout=60)
+        svc.close()
+
+    ``submit`` raises `CylonError` (`Code.ResourceExhausted` /
+    `Code.Unavailable`, ``retry_after_s`` set) when the request is shed
+    at admission; an admitted `Ticket` ALWAYS terminates — completed,
+    failed classified, cancelled, or shed by a drain — never a hang.
+    """
+
+    def __init__(self, ctx=None, *, queue_cap: Optional[int] = None,
+                 budgets: Optional[Dict[str, TenantBudget]] = None,
+                 name: str = "serve"):
+        self._ctx = ctx
+        self._cap = int(queue_cap) if queue_cap is not None \
+            else _default_queue_cap()
+        self._budgets: Dict[str, TenantBudget] = dict(budgets or {})
+        self.name = name
+        self._lock = threading.Condition()
+        self._queue: "deque[Ticket]" = deque()
+        self._running: Optional[Ticket] = None
+        self._tenants: Dict[str, _TenantState] = {}
+        self._draining = False
+        self._closed = False
+        self._ewma_s: Optional[float] = None
+        self._counts = {"admitted": 0, "shed": 0, "completed": 0,
+                        "failed": 0, "cancelled": 0, "cache_hits": 0,
+                        "tenants_quarantined": 0}
+        self._thread = threading.Thread(target=self._scheduler_loop,
+                                        name=f"cylon-{name}", daemon=True)
+        self._thread.start()
+
+    # -- context manager --------------------------------------------------
+
+    def __enter__(self) -> "QueryService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- admission (caller threads; device-free — cylint CY107) -----------
+
+    def set_budget(self, tenant: str, budget: TenantBudget) -> None:
+        with self._lock:
+            self._budgets[str(tenant)] = budget
+
+    def _tenant(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = self._tenants[tenant] = _TenantState()
+        return st
+
+    def _retry_after(self, ahead: int) -> float:
+        """When capacity plausibly returns: the request-duration EWMA
+        times the work ahead of the caller.  A hint, not a promise."""
+        per = self._ewma_s if self._ewma_s is not None else 0.25
+        return max(0.05, per * max(1, ahead))
+
+    def _shed(self, tenant: str, code: Code, reason: str,
+              retry_after: Optional[float]) -> CylonError:
+        st = self._tenant(tenant)
+        st.shed += 1
+        self._counts["shed"] += 1
+        obs_metrics.counter_add("serve.shed")
+        obs_spans.instant("serve.shed", tenant=tenant, code=code.name,
+                          reason=reason)
+        hint = "" if retry_after is None else f"; retry after ~{retry_after:.2f}s"
+        return CylonError(code, f"request shed for tenant {tenant!r}: "
+                                f"{reason}{hint}",
+                          retry_after_s=retry_after)
+
+    def submit(self, tenant: str, op: str, *args, **kwargs) -> Ticket:
+        """Admit one table op (``op`` in :data:`OPS`; ``args``/``kwargs``
+        forwarded to the chunked engine) or shed it NOW with a
+        classified `CylonError` carrying ``retry_after_s``.  Runs
+        entirely on the caller's thread and never blocks on the device
+        or the queue."""
+        tenant = str(tenant)
+        if op not in _RUNNERS:
+            raise CylonError(Code.Invalid,
+                             f"unknown op {op!r} (expected one of {OPS})")
+        est = _estimate_request_bytes(args, kwargs)
+        try:
+            resilience.fault_point("serve.admit")
+        except Exception as e:
+            # an injected admission fault (`tenant_flood`) sheds exactly
+            # like a real budget trip — same code, same hint
+            with self._lock:
+                err = self._shed(tenant, Code.ResourceExhausted,
+                                 Status.from_exception(e).msg,
+                                 self._retry_after(len(self._queue) + 1))
+            raise err
+        with self._lock:
+            if self._closed or self._draining:
+                raise self._shed(tenant, Code.Unavailable,
+                                 "service is draining", None)
+            st = self._tenant(tenant)
+            now = time.monotonic()
+            if st.quarantined_until > now:
+                raise self._shed(tenant, Code.Unavailable,
+                                 f"tenant quarantined after {st.streak} "
+                                 f"consecutive failures",
+                                 st.quarantined_until - now)
+            if st.quarantined_until:
+                # cooldown elapsed: the tenant re-enters with a CLEAN
+                # failure streak (the knob's contract) — otherwise one
+                # transient post-cooldown failure would re-quarantine
+                # instantly
+                st.quarantined_until = 0.0
+                st.streak = 0
+            depth = len(self._queue) + (1 if self._running is not None else 0)
+            if len(self._queue) >= self._cap:
+                raise self._shed(tenant, Code.ResourceExhausted,
+                                 f"admission queue full "
+                                 f"({len(self._queue)}/{self._cap})",
+                                 self._retry_after(depth + 1))
+            budget = self._budgets.get(tenant)
+            tcap = budget.max_queued if budget is not None \
+                and budget.max_queued is not None \
+                else max(1, int(-(-self._cap * tenant_share() // 1)))
+            if st.queued >= tcap:
+                raise self._shed(tenant, Code.ResourceExhausted,
+                                 f"tenant queue share full "
+                                 f"({st.queued}/{tcap} of {self._cap})",
+                                 self._retry_after(st.queued + 1))
+            hbm_cap = budget.hbm_bytes if budget is not None \
+                and budget.hbm_bytes is not None else hbm_budget_bytes()
+            if hbm_cap > 0:
+                live = obs_metrics.record_hbm_watermark()
+                if est + live > hbm_cap:
+                    raise self._shed(
+                        tenant, Code.ResourceExhausted,
+                        f"HBM admission estimate {est} + live {live} "
+                        f"exceeds the {hbm_cap}-byte tenant budget",
+                        self._retry_after(depth + 1))
+            ticket = Ticket(self, tenant, op, args, kwargs)
+            self._queue.append(ticket)
+            st.queued += 1
+            st.admitted += 1
+            self._counts["admitted"] += 1
+            obs_metrics.counter_add("serve.admitted")
+            obs_metrics.gauge_set("serve.queue_depth", len(self._queue))
+            self._lock.notify_all()
+        return ticket
+
+    def _cancel_ticket(self, ticket: Ticket) -> bool:
+        with self._lock:
+            if ticket.done:
+                return False
+            if ticket in self._queue:
+                self._queue.remove(ticket)
+                st = self._tenant(ticket.tenant)
+                st.queued -= 1
+                st.cancelled += 1
+                self._counts["cancelled"] += 1
+                obs_metrics.counter_add("serve.cancelled")
+                obs_metrics.gauge_set("serve.queue_depth", len(self._queue))
+                ticket._finish(CANCELLED, error=CylonError(
+                    Code.Cancelled,
+                    f"request cancelled while queued (tenant "
+                    f"{ticket.tenant!r})"))
+                return True
+        # running (or about to): the pass_guard stops it at the next
+        # pass boundary — completed passes stay journaled
+        ticket._cancel.set()
+        return not ticket.done
+
+    # -- scheduling (the one worker thread) --------------------------------
+
+    _STOP = object()
+
+    def _dispatch_next(self):
+        """Pick the next admitted ticket — scheduling decisions ONLY, no
+        device work on this path (cylint CY107): a wedged device must
+        never block shedding or drain.  Returns a ticket, None (nothing
+        actionable this tick), or ``_STOP``."""
+        with self._lock:
+            while not self._queue:
+                if self._closed:
+                    return self._STOP
+                self._lock.wait(0.05)
+            ticket = self._queue.popleft()
+            st = self._tenant(ticket.tenant)
+            st.queued -= 1
+            obs_metrics.gauge_set("serve.queue_depth", len(self._queue))
+            self._running = ticket
+        if ticket._cancel.is_set():
+            self._finish_cancelled(ticket, "before dispatch")
+            with self._lock:
+                self._running = None
+                self._lock.notify_all()
+            return None
+        try:
+            resilience.fault_point("serve.dispatch")
+        except Exception as e:
+            with self._lock:
+                err = self._shed(ticket.tenant, Code.Unavailable,
+                                 Status.from_exception(e).msg,
+                                 self._retry_after(1))
+                self._running = None
+                self._lock.notify_all()
+            ticket._finish(SHED, error=err)
+            return None
+        return ticket
+
+    def _scheduler_loop(self) -> None:
+        while True:
+            ticket = self._dispatch_next()
+            if ticket is self._STOP:
+                return
+            if ticket is None:
+                continue
+            try:
+                self._run_ticket(ticket)
+            finally:
+                with self._lock:
+                    self._running = None
+                    self._lock.notify_all()
+
+    def _finish_cancelled(self, ticket: Ticket, where: str) -> None:
+        with self._lock:
+            st = self._tenant(ticket.tenant)
+            st.cancelled += 1
+            self._counts["cancelled"] += 1
+            obs_metrics.counter_add("serve.cancelled")
+        ticket._finish(CANCELLED, error=CylonError(
+            Code.Cancelled, f"request cancelled {where} (tenant "
+                            f"{ticket.tenant!r})"))
+
+    # -- execution (device work lives here and only here) ------------------
+
+    def _request_deadline_s(self, tenant: str) -> float:
+        b = self._budgets.get(tenant)
+        if b is not None and b.deadline_s is not None:
+            return max(0.0, float(b.deadline_s))
+        return default_deadline_s()
+
+    def _run_ticket(self, ticket: Ticket) -> None:
+        tenant = ticket.tenant
+        deadline_s = self._request_deadline_s(tenant)
+        dl = durable.PassDeadline(deadline_s, f"serve.request.{tenant}") \
+            if deadline_s > 0 else None
+
+        def guard():
+            # the engine calls this before every pass: cancellation and
+            # the request budget both stop the run at a pass BOUNDARY, so
+            # completed (journaled) work is never abandoned mid-flight
+            if ticket._cancel.is_set():
+                raise CylonError(Code.Cancelled,
+                                 f"request cancelled (tenant {tenant!r})")
+            if dl is not None and dl.fired.is_set():
+                raise CylonError(Code.Timeout,
+                                 f"request exceeded its {deadline_s:g}s "
+                                 f"budget (tenant {tenant!r})")
+
+        ticket.state = RUNNING
+        t0 = time.perf_counter()
+        runner = _RUNNERS[ticket.op]
+        with obs_spans.span("serve.request", tenant=tenant,
+                            op=ticket.op) as sp:
+            try:
+                with (dl if dl is not None else contextlib.nullcontext()):
+                    result, stats = runner(*ticket.args, ctx=self._ctx,
+                                           pass_guard=guard,
+                                           **ticket.kwargs)
+            except Exception as e:
+                self._finish_failed(ticket, e)
+                return
+            finally:
+                dur = time.perf_counter() - t0
+                ticket.duration_s = dur
+                if obs_spans.events_enabled():
+                    sp.set(seconds=round(dur, 6), state=ticket.state)
+        hit = cache_mod.served_from_journal(stats)
+        with self._lock:
+            st = self._tenant(tenant)
+            st.streak = 0
+            st.served += 1
+            self._counts["completed"] += 1
+            if hit:
+                st.cache_hits += 1
+                self._counts["cache_hits"] += 1
+            # request-duration EWMA drives the retry-after hints; cache
+            # hits are excluded (they predict nothing about device cost)
+            if not hit:
+                d = ticket.duration_s
+                self._ewma_s = d if self._ewma_s is None \
+                    else 0.7 * self._ewma_s + 0.3 * d
+        obs_metrics.counter_add("serve.completed")
+        if hit:
+            obs_metrics.counter_add("serve.cache_hit")
+            obs_spans.instant("serve.cache_hit", tenant=tenant,
+                              op=ticket.op)
+        ticket.cache_hit = hit
+        ticket._finish(DONE, result=result, stats=stats)
+        # no GC here: the engine already runs the CYLON_TPU_DURABLE_CAP_
+        # BYTES eviction when it records a journaled run complete;
+        # cache.maybe_gc() stays available as a manual sweep
+
+    def _finish_failed(self, ticket: Ticket, exc: Exception) -> None:
+        st_code = Status.from_exception(exc)
+        if st_code.code == Code.Cancelled:
+            self._finish_cancelled(ticket, "at a pass boundary")
+            return
+        err = exc if isinstance(exc, CylonError) \
+            else CylonError(st_code.code, st_code.msg)
+        quarantined = False
+        with self._lock:
+            st = self._tenant(ticket.tenant)
+            st.failed += 1
+            st.streak += 1
+            self._counts["failed"] += 1
+            qn = tenant_quarantine_after()
+            if qn > 0 and st.streak >= qn:
+                st.quarantined_until = time.monotonic() + tenant_quarantine_s()
+                self._counts["tenants_quarantined"] += 1
+                quarantined = True
+        obs_metrics.counter_add("serve.failed")
+        if quarantined:
+            obs_metrics.counter_add("serve.tenants_quarantined")
+            obs_spans.instant("serve.tenant_quarantined",
+                              tenant=ticket.tenant, streak=st.streak,
+                              code=err.code.name)
+        ticket._finish(FAILED, error=err)
+
+    # -- drain / close ------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = 60.0) -> List[Ticket]:
+        """Graceful drain: stop admitting (subsequent submits shed with
+        `Code.Unavailable`), shed everything QUEUED with the same code,
+        and wait up to ``timeout`` for the in-flight request to finish
+        or journal.  Returns the shed tickets.  Idempotent."""
+        with self._lock:
+            self._draining = True
+            shed = list(self._queue)
+            self._queue.clear()
+            for t in shed:
+                st = self._tenant(t.tenant)
+                st.queued -= 1
+                err = self._shed(t.tenant, Code.Unavailable,
+                                 "service draining", None)
+                t._finish(SHED, error=err)
+            obs_metrics.gauge_set("serve.queue_depth", 0)
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            while self._running is not None:
+                rem = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                if rem == 0.0:
+                    break
+                self._lock.wait(rem if rem is not None else 0.1)
+        return shed
+
+    def close(self, timeout: Optional[float] = 60.0) -> None:
+        """Drain, then stop the scheduler thread."""
+        self.drain(timeout)
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+        self._thread.join(timeout=5.0)
+
+    # -- introspection ------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def stats(self) -> dict:
+        """Deterministic service report: the artifact the serve smoke and
+        the flood tests assert against."""
+        with self._lock:
+            per = {
+                t: {"admitted": s.admitted, "served": s.served,
+                    "shed": s.shed, "failed": s.failed,
+                    "cancelled": s.cancelled, "cache_hits": s.cache_hits,
+                    "quarantined": s.quarantined_until > time.monotonic()}
+                for t, s in sorted(self._tenants.items())
+            }
+            return {**self._counts, "queue_depth": len(self._queue),
+                    "queue_cap": self._cap, "draining": self._draining,
+                    "tenants": per}

@@ -38,8 +38,17 @@ class Code(enum.IntEnum):
     # no one to retry against, and re-running a pass into a changed
     # membership is the desync PR 1's no-retry-collectives rule bans —
     # the elastic loop re-PLANS at the new world instead.
-    Unavailable = 47      # control plane (coordinator) gone
+    Unavailable = 47      # control plane gone / service draining or closed
     EpochMismatch = 48    # membership moved under in-flight work
+    # serving codes (PR 7).  ResourceExhausted is the ADMISSION-layer
+    # sibling of OutOfMemory: the request was never attempted because a
+    # bounded queue / tenant budget had no room — deterministically
+    # retryable by the CALLER (rejects carry a retry-after hint), but
+    # never by the engine (nothing in-flight exists to retry).
+    # Cancelled is a caller's own decision echoed back; retrying it
+    # would countermand the cancel, so it is non-retryable too.
+    ResourceExhausted = 49
+    Cancelled = 50
 
 
 # Failure-text classification tables (lowercase substrings).  PJRT raises
@@ -117,12 +126,19 @@ class Status:
 
 
 class CylonError(Exception):
-    """Exception raised by the Python-first API when an operation fails."""
+    """Exception raised by the Python-first API when an operation fails.
 
-    def __init__(self, code: Code, msg: str):
+    ``retry_after_s`` (serving layer, PR 7): on admission rejects
+    (`Code.ResourceExhausted` / `Code.Unavailable` sheds) it carries the
+    service's estimate of when capacity returns — the classified
+    alternative to an unbounded wait.  None everywhere else."""
+
+    def __init__(self, code: Code, msg: str,
+                 retry_after_s: "float | None" = None):
         super().__init__(f"[{code.name}] {msg}")
         self.code = code
         self.msg = msg
+        self.retry_after_s = retry_after_s
 
 
 def raise_not_ok(status: Status) -> None:

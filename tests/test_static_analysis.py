@@ -306,6 +306,66 @@ def test_cy106_only_fires_in_the_elastic_module(tmp_path):
     assert "CY106" not in {f.rule for f in found}
 
 
+def _scan_serve(tmp_path, src):
+    """CY107 fixtures must live under cylon_tpu/serve/ for the module
+    name to resolve into the serving namespace."""
+    d = tmp_path / "cylon_tpu" / "serve"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / "service.py"
+    p.write_text(textwrap.dedent(src))
+    return astlint.scan_paths([str(p)])
+
+
+def test_cy107_blocking_device_call_on_control_path(tmp_path):
+    found = _scan_serve(tmp_path, """\
+        import jax
+
+        def _fetch(x):
+            return jax.block_until_ready(x)
+
+        class Service:
+            def submit(self, x):
+                return self._admit_check(x)
+
+            def _admit_check(self, x):
+                return _fetch(x)
+        """)
+    # both the root and the _admit* helper reach the blocking call
+    # (self.X calls resolve against same-module functions)
+    assert _rules_at(found) == [("CY107", 7), ("CY107", 10)]
+    assert "block_until_ready" in found[0].msg
+    assert "shedding" in found[0].msg
+
+
+def test_cy107_executor_device_work_is_clean(tmp_path):
+    # device work in the executor (_run_ticket) is the design; only the
+    # admission/dispatch control path must stay device-free
+    found = _scan_serve(tmp_path, """\
+        import jax
+
+        class Service:
+            def submit(self, x):
+                self._queue.append(x)
+
+            def _dispatch_next(self):
+                return self._queue.popleft()
+
+            def _run_ticket(self, x):
+                return jax.device_get(x)
+        """)
+    assert found == []
+
+
+def test_cy107_only_fires_under_the_serve_package(tmp_path):
+    found = _scan(tmp_path, """\
+        import jax
+
+        def submit(x):
+            return jax.block_until_ready(x)
+        """)
+    assert "CY107" not in {f.rule for f in found}
+
+
 def test_cy001_suppression_requires_justification(tmp_path):
     # no justification: the suppression itself is the finding (and does
     # not silence the underlying rule)

@@ -160,6 +160,71 @@ if [ $rc -ne 0 ]; then
   echo "elastic kill-one-resume smoke failed (rc=$rc); fix elastic membership before the full tree" >&2
   exit $rc
 fi
+# serve smoke (ISSUE-7): flood a 2-tenant query service against a
+# single-slot admission queue — overload must resolve as classified
+# sheds + exact serves (never a hang), and a repeated query must hit
+# the journal result cache; counts asserted from the artifact JSON —
+# catches an admission/cache regression in ~30 s, before the full tree
+SJ=$(mktemp -d /tmp/cylon_serve_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_DURABLE_DIR="$SJ/journal" \
+    python - "$SJ" <<'PYEOF'
+import json, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cylon_tpu.serve import QueryService
+from cylon_tpu.status import CylonError, Code
+from cylon_tpu.exec import chunked_join
+
+td = sys.argv[1]
+rng = np.random.default_rng(7)
+def mk(seed):
+    r = np.random.default_rng(seed)
+    n = 1200
+    return ({"k": r.integers(0, n, n).astype(np.int64),
+             "a": r.random(n).astype(np.float32)},
+            {"k": r.integers(0, n, n).astype(np.int64),
+             "b": r.random(n).astype(np.float32)})
+inputs = {"tenant-a": mk(1), "tenant-b": mk(2)}
+oracle = {t: chunked_join(l, r, on="k", passes=2, mode="hash")[0]
+          for t, (l, r) in inputs.items()}
+svc = QueryService(queue_cap=1)
+admitted, shed = [], 0
+for _ in range(5):
+    for t, (l, r) in inputs.items():
+        try:
+            admitted.append((t, svc.submit(t, "join", l, r, on="k",
+                                           passes=2, mode="hash")))
+        except CylonError as e:
+            assert e.code in (Code.ResourceExhausted, Code.Unavailable), e
+            shed += 1
+for t, ticket in admitted:
+    res, _ = ticket.result(timeout=180)
+    for k in oracle[t]:
+        np.testing.assert_array_equal(res[k], oracle[t][k])
+# repeated fingerprint: the journal serves it with zero device passes
+ca, cb = inputs["tenant-a"]
+hit = svc.submit("tenant-a", "join", ca, cb, on="k", passes=2, mode="hash")
+hit.result(timeout=180)
+stats = svc.stats()
+svc.close()
+with open(f"{td}/serve_smoke.json", "w") as fh:
+    json.dump(stats, fh, indent=1, sort_keys=True)
+assert stats["shed"] == shed and shed > 0, stats
+assert stats["completed"] == len(admitted) + 1, stats
+assert stats["failed"] == 0, stats
+assert stats["cache_hits"] >= 1, stats
+print(f"serve smoke ok: admitted={stats['admitted']} shed={stats['shed']} "
+      f"cache_hits={stats['cache_hits']} "
+      f"artifact={td}/serve_smoke.json")
+PYEOF
+rc=$?
+rm -rf "$SJ"
+if [ $rc -ne 0 ]; then
+  echo "serve smoke failed (rc=$rc); fix the query service before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

@@ -80,6 +80,19 @@ def self_times(events: List[dict]) -> Dict[str, Tuple[int, float, float]]:
     return {n: (count[n], total[n], self_t[n]) for n in total}
 
 
+def tenant_attribution(events: List[dict]) -> Dict[str, Tuple[int, float]]:
+    """{tenant: (request count, total_us)} over ``serve.request`` spans —
+    per-tenant attribution of where the mesh's serving time went."""
+    out: Dict[str, Tuple[int, float]] = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") != "serve.request":
+            continue
+        tenant = str(e.get("args", {}).get("tenant", "?"))
+        n, us = out.get(tenant, (0, 0.0))
+        out[tenant] = (n + 1, us + e.get("dur", 0))
+    return out
+
+
 def print_report(trace_path: str, metrics_path: "str | None",
                  top: int) -> None:
     doc = load_trace(trace_path)
@@ -105,6 +118,14 @@ def print_report(trace_path: str, metrics_path: "str | None",
         print("\ninstant events:")
         for name in sorted(instants):
             print(f"  {name:32s} {instants[name]:7d}")
+
+    tenants = tenant_attribution(events)
+    if tenants:
+        print("\nper-tenant serving attribution:")
+        print(f"  {'tenant':24s} {'requests':>8s} {'total ms':>10s}")
+        for t in sorted(tenants, key=lambda t: -tenants[t][1]):
+            n, us = tenants[t]
+            print(f"  {t:24s} {n:8d} {us / 1e3:10.3f}")
 
     if metrics_path is None:
         import re
@@ -149,6 +170,22 @@ def print_report(trace_path: str, metrics_path: "str | None",
             print(f"  deadlines / quarantined    "
                   f"{int(c.get('deadline.fired', 0))}/"
                   f"{int(c.get('quarantine.parts', 0))}")
+        if any(k.startswith("serve.") for k in c):
+            # serving summary: admission vs shed vs cache traffic — the
+            # overload story in four lines
+            print(f"  serve admitted / shed      "
+                  f"{int(c.get('serve.admitted', 0))}/"
+                  f"{int(c.get('serve.shed', 0))}")
+            print(f"  serve completed / failed   "
+                  f"{int(c.get('serve.completed', 0))}/"
+                  f"{int(c.get('serve.failed', 0))}")
+            evicts = int(c.get("serve.cache_evictions", 0)
+                         or c.get("durable.gc_runs_evicted", 0))
+            print(f"  serve cache hits / evicts  "
+                  f"{int(c.get('serve.cache_hit', 0))}/{evicts}")
+            print(f"  serve cancelled / tenants quarantined "
+                  f"{int(c.get('serve.cancelled', 0))}/"
+                  f"{int(c.get('serve.tenants_quarantined', 0))}")
         g = m.get("gauges", {})
         if "hbm.live_bytes" in g:
             print(f"  hbm watermark bytes        "
