@@ -352,6 +352,35 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
        accessors=("cylon_tpu.obs.spans.buffer_cap",),
        help="Maximum buffered span events per process; past it new events "
             "are dropped and counted (obs.spans.dropped), never grown."),
+    _K("CYLON_TPU_RUN_ID", "str", "", RUNTIME,
+       accessors=("cylon_tpu.obs.fleet.current_run_id",),
+       help="Logical run id namespacing trace/metrics exports "
+            "(trace.<run_id>.r<rank>.json) and flight-recorder dumps, so "
+            "back-to-back runs sharing CYLON_TPU_TRACE_DIR never clobber.  "
+            "elastic_run installs its own run_id when this is unset; empty "
+            "(default) keeps the flat per-rank naming."),
+    _K("CYLON_TPU_FLIGHT_RING_CAP", "int", 512, RUNTIME,
+       accessors=("cylon_tpu.obs.spans.ring_cap",
+                  "cylon_tpu.obs.fleet.flight_enabled"),
+       help="Always-on flight-recorder ring: the most recent N span/"
+            "instant events are kept even when CYLON_TPU_TRACE=1 event "
+            "buffering is off, and auto-dumped with a metrics snapshot to "
+            "CYLON_TPU_TRACE_DIR/flight/<run_id>.r<rank>.json on any "
+            "classified terminal event (quarantine, shed, rank loss, "
+            "straggler fencing, fatal pass failure) — post-mortems never "
+            "depend on pre-armed tracing.  0 disables the ring and the "
+            "recorder."),
+    _K("CYLON_TPU_CLOCK_SYNC_N", "int", 8, RUNTIME,
+       accessors=("cylon_tpu.elastic.clock_sync_rounds",),
+       help="Round trips per clock-alignment handshake when an elastic "
+            "agent joins: NTP-style best-of-N offset/uncertainty against "
+            "the coordinator clock (tools/trace_merge.py aligns per-rank "
+            "traces with it), refined one round per heartbeat."),
+    _K("CYLON_TPU_FAULT_DELAY_S", "float", 0.25, RUNTIME,
+       accessors=("cylon_tpu.resilience.fault_delay_s",),
+       help="Sleep injected by the `delay` fault kind (a seeded straggler "
+            "for skew-attribution tests: the process keeps heartbeating "
+            "but arrives late at every collective)."),
     _K("CYLON_TEST_NO_COMPILE_CACHE", "bool", False, RUNTIME,
        help="Disable the per-backend persistent XLA compile cache.  Read "
             "directly in utils/compile_cache.py (the enabler must work "

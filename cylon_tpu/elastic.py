@@ -59,9 +59,12 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from collections import deque
+
 from . import config
 from . import resilience
 from .net import control
+from .obs import fleet as obs_fleet
 from .obs import metrics as obs_metrics
 from .obs import spans as obs_spans
 from .status import Code, CylonError, Status
@@ -92,6 +95,16 @@ def heartbeat_timeout() -> float:
     """``CYLON_TPU_HEARTBEAT_TIMEOUT_S``: silence after which a rank is
     declared dead."""
     return max(0.05, float(config.knob("CYLON_TPU_HEARTBEAT_TIMEOUT_S")))
+
+
+def clock_sync_rounds() -> int:
+    """``CYLON_TPU_CLOCK_SYNC_N``: round trips per clock handshake."""
+    return max(1, int(config.knob("CYLON_TPU_CLOCK_SYNC_N")))
+
+
+#: a kept clock offset older than this is replaced even by a noisier
+#: measurement — bounded staleness beats a lucky-but-ancient RTT
+CLOCK_MAX_AGE_S = 30.0
 
 
 def _parse_address(addr) -> Tuple[str, int]:
@@ -171,7 +184,15 @@ class Coordinator:
         self._epoch = 0
         self._last_hb: Dict[int, float] = {}     # alive ranks -> monotonic
         self._dead: Dict[int, str] = {}          # rank -> reason
-        self._barriers: Dict[Tuple[str, int], set] = {}
+        # barrier arrival instants (coordinator clock, perf_counter_ns):
+        # rank -> first-arrival timestamp; on completion the spread is the
+        # collective's SKEW — the slowest participant's cost to everyone
+        # (the arxiv 1810.11112 attribution, measured on one real clock)
+        self._barriers: Dict[Tuple[str, int], Dict[int, int]] = {}
+        self._clocks: Dict[int, Dict] = {}       # rank -> offset/uncertainty
+        self._telemetry: Dict[int, Dict] = {}    # rank -> serve telemetry
+        self._skews: "deque[Dict]" = deque(maxlen=64)
+        self._pending_flight: List[Dict] = []    # staged rank-loss dumps
         # latched completed rendezvous, insertion-ordered dict-as-set so
         # the bound evicts oldest-first (a slow member only ever polls a
         # RECENTLY completed barrier)
@@ -224,13 +245,31 @@ class Coordinator:
                         if now - hb > self.timeout]
                 for rank in late:
                     self._mark_dead_locked(rank, "heartbeat timeout")
+            self._flush_flight()
 
     def _mark_dead_locked(self, rank: int, reason: str) -> None:
         if rank in self._dead or rank not in self._last_hb:
             return
         del self._last_hb[rank]
+        # a dead rank's telemetry/clock must leave the status aggregate
+        # with it — otherwise its last-reported queue depth haunts the
+        # fleet view forever
+        self._clocks.pop(rank, None)
+        self._telemetry.pop(rank, None)
         self._dead[rank] = reason
         self._epoch += 1
+        # rank loss is a classified terminal event: the coordinator's
+        # flight dump records WHO died, WHY, and the control-plane events
+        # leading up to it — even when the dead process took its own
+        # trace down with it (rank_kill is os._exit: nothing flushes).
+        # STAGED here, written by _flush_flight outside the lock — a
+        # slow disk must never block heartbeat processing into
+        # cascading false timeouts.  A clean leave is not a failure and
+        # does not dump.
+        if reason != "left":
+            self._pending_flight.append(dict(
+                lost_rank=rank, loss_reason=reason, epoch=self._epoch,
+                members=sorted(self._last_hb)))
         # pending barriers from earlier epochs can never complete (their
         # pollers get epoch_changed and re-enter at the new epoch): drop
         # them so arrival sets don't accumulate across a long shrink
@@ -251,17 +290,88 @@ class Coordinator:
                 "members": sorted(self._last_hb),
                 "world": self.world}
 
+    def _record_skew_locked(self, name: str, epoch: int,
+                            arrived: Dict[int, int]) -> None:
+        """Account one completed rendezvous: the arrival spread IS the
+        collective's skew (everyone waits for the last arrival), on the
+        coordinator's single clock — no alignment uncertainty at all."""
+        first = min(arrived.values())
+        slowest = max(arrived, key=arrived.get)
+        skew_ns = arrived[slowest] - first
+        obs_metrics.hist_observe("collective.skew_ns", skew_ns)
+        obs_spans.instant("collective.skew", collective=name, epoch=epoch,
+                          skew_ns=skew_ns, slowest_rank=slowest)
+        self._skews.append({
+            "collective": name, "epoch": epoch, "skew_ns": int(skew_ns),
+            "slowest_rank": int(slowest),
+            "arrivals_ns": {str(r): int(t - first)
+                            for r, t in sorted(arrived.items())}})
+
+    def _serve_status_locked(self) -> Dict:
+        """Aggregate the per-rank serve telemetry heartbeats carry: total
+        queue depth plus per-tenant SLO latency histograms (queue-wait vs
+        run split), merged across ranks."""
+        agg: Dict[str, object] = {"queue_depth": 0, "tenants": {}}
+        tenants: Dict[str, Dict] = agg["tenants"]  # type: ignore[assignment]
+        for _rank, tel in sorted(self._telemetry.items()):
+            agg["queue_depth"] += int(tel.get("queue_depth", 0) or 0)
+            for t, row in sorted((tel.get("tenants") or {}).items()):
+                dst = tenants.setdefault(t, {})
+                for key in ("queue_wait_ms", "run_ms"):
+                    h = row.get(key)
+                    if isinstance(h, dict):
+                        dst[key] = obs_fleet.merge_hist(dst.get(key), h)
+                for key in ("served", "shed", "failed", "cache_hits"):
+                    if key in row:
+                        dst[key] = int(dst.get(key, 0)) + int(row[key])
+        return agg
+
     def view(self) -> MemberView:
         with self._lock:
             v = self._view_locked()
         return MemberView(v["epoch"], tuple(v["members"]), v["world"])
 
+    def _flush_flight(self) -> None:
+        """Write the staged rank-loss flight dumps OUTSIDE the
+        membership lock (called after each detector sweep and each
+        handled request)."""
+        while True:
+            with self._lock:
+                if not self._pending_flight:
+                    return
+                kw = self._pending_flight.pop(0)
+            obs_fleet.flight_record("rank_lost", rank="coord", **kw)
+
     def _handle(self, req: Dict) -> Dict:
+        try:
+            return self._handle_inner(req)
+        finally:
+            # report_failure / leave mark ranks dead under the lock;
+            # their dumps are written here, after it is released
+            self._flush_flight()
+
+    def _handle_inner(self, req: Dict) -> Dict:
+        t_recv = time.perf_counter_ns()
         cmd = req.get("cmd")
         rank = req.get("rank")
+        if cmd == "clock":
+            # the NTP-style handshake leg: lock-free, so a blocked
+            # membership operation cannot inflate the apparent one-way
+            # delay (uncertainty IS the product here).  Fenced ranks may
+            # still sync — a straggler's post-mortem trace needs
+            # alignment more than anyone's.
+            return {"ok": True, "t_recv": t_recv,
+                    "t_send": time.perf_counter_ns()}
         with self._lock:
             if cmd == "status":
+                now = time.monotonic()
                 return {"ok": True, "dead": dict(self._dead),
+                        "ranks": {str(r): {
+                            "hb_age_s": round(now - hb, 6),
+                            "clock": self._clocks.get(r)}
+                            for r, hb in sorted(self._last_hb.items())},
+                        "serve": self._serve_status_locked(),
+                        "collectives": list(self._skews),
                         **self._view_locked()}
             if not isinstance(rank, int):
                 return {"ok": False, "error": f"bad rank {rank!r}"}
@@ -285,6 +395,14 @@ class Coordinator:
                     return {"ok": False, "status": "rejected",
                             "reason": "unknown rank", **self._view_locked()}
                 self._last_hb[rank] = time.monotonic()
+                ci = req.get("clock")
+                if isinstance(ci, dict):
+                    self._clocks[rank] = {
+                        "offset_ns": int(ci.get("offset_ns", 0)),
+                        "uncertainty_ns": int(ci.get("uncertainty_ns", 0))}
+                tel = req.get("telemetry")
+                if isinstance(tel, dict):
+                    self._telemetry[rank] = tel
                 return {"ok": True, **self._view_locked()}
             if cmd == "barrier":
                 name, epoch = str(req.get("name")), req.get("epoch")
@@ -305,14 +423,17 @@ class Coordinator:
                     # remaining ranks exist to be counted
                     return {"ok": True, "status": "wait",
                             **self._view_locked()}
-                arrived = self._barriers.setdefault((name, epoch), set())
-                arrived.add(rank)
-                if set(self._last_hb) <= arrived:
+                arrived = self._barriers.setdefault((name, epoch), {})
+                # first arrival wins: re-polls of a waiting rank must not
+                # slide its arrival instant forward
+                arrived.setdefault(rank, t_recv)
+                if set(self._last_hb) <= set(arrived):
                     del self._barriers[(name, epoch)]
                     self._completed_barriers[(name, epoch)] = True
                     while len(self._completed_barriers) > 256:
                         self._completed_barriers.pop(
                             next(iter(self._completed_barriers)))
+                    self._record_skew_locked(name, epoch, arrived)
                     return {"ok": True, "status": "go",
                             **self._view_locked()}
                 return {"ok": True, "status": "wait", **self._view_locked()}
@@ -369,6 +490,8 @@ class Agent:
         self._fenced = False        # coordinator declared US dead
         self._silenced = False      # heartbeat_loss fault: stop beating
         self._thread: Optional[threading.Thread] = None
+        self.clock: Optional[obs_fleet.ClockInfo] = None
+        self._telemetry_fn: Optional[Callable[[], Dict]] = None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -392,6 +515,18 @@ class Agent:
         if not resp.get("ok"):
             raise CylonError(Code.Invalid,
                              f"rank {self.rank}: join rejected: {resp}")
+        # fleet identity: exports name artifacts by the ELASTIC rank (the
+        # jax.process_index fallback reports 0 on every single-controller
+        # process) — first agent wins in multi-agent test processes
+        obs_fleet.set_rank(self.rank)
+        try:
+            self.sync_clock()
+        except (OSError, ValueError) as e:
+            # clock alignment is best-effort at join: the per-heartbeat
+            # refinement keeps trying, and a missing offset only degrades
+            # trace MERGING, never the run
+            log.warning("elastic: rank %d initial clock sync failed: "
+                        "%s: %s", self.rank, type(e).__name__, e)
         self._thread = threading.Thread(target=self._beat, daemon=True,
                                         name=f"cylon-elastic-hb-r{self.rank}")
         self._thread.start()
@@ -414,6 +549,55 @@ class Agent:
 
     def _rpc(self, obj: Dict) -> Dict:
         return control.request(self._addr, obj, timeout=self._rpc_timeout)
+
+    # -- clock alignment + telemetry -------------------------------------
+
+    def sync_clock(self, rounds: Optional[int] = None
+                   ) -> Optional[obs_fleet.ClockInfo]:
+        """One clock handshake against the coordinator (best of
+        ``rounds``, default ``CYLON_TPU_CLOCK_SYNC_N``).  The kept offset
+        only improves — a noisier later measurement is discarded unless
+        the current one has aged past ``CLOCK_MAX_AGE_S`` (bounded
+        staleness under drift).  Returns the kept `ClockInfo`."""
+        info = obs_fleet.measure_offset(
+            self._rpc, ref=f"{self._addr[0]}:{self._addr[1]}",
+            rank=self.rank,
+            rounds=clock_sync_rounds() if rounds is None else rounds)
+        with self._lock:
+            cur = self.clock
+            if (cur is None or info.uncertainty_ns < cur.uncertainty_ns
+                    or time.monotonic() - cur.measured_mono
+                    > CLOCK_MAX_AGE_S):
+                self.clock = info
+            kept = self.clock
+        # publish to the process-wide fleet identity only when we ARE it
+        # (in-process multi-agent tests: rank 0 owns the export naming,
+        # so it must own the exported clock too)
+        if obs_fleet.current_rank() in (None, self.rank):
+            obs_fleet.set_clock(kept)
+        return kept
+
+    def attach_telemetry(self, fn: Optional[Callable[[], Dict]]) -> None:
+        """Install a callable whose dict result rides every heartbeat
+        (e.g. ``QueryService.telemetry``): the coordinator aggregates it
+        into the ``status`` verb's fleet-wide serving view."""
+        with self._lock:
+            self._telemetry_fn = fn
+
+    def _heartbeat_payload(self) -> Dict:
+        obj: Dict = {"cmd": "heartbeat", "rank": self.rank}
+        with self._lock:
+            ci, fn = self.clock, self._telemetry_fn
+        if ci is not None:
+            obj["clock"] = {"offset_ns": ci.offset_ns,
+                            "uncertainty_ns": ci.uncertainty_ns}
+        if fn is not None:
+            try:
+                obj["telemetry"] = fn()
+            except Exception as e:  # telemetry must never kill the beat
+                log.debug("elastic: rank %d telemetry fn failed: %s: %s",
+                          self.rank, type(e).__name__, e)
+        return obj
 
     def _absorb(self, resp: Dict) -> None:
         """Fold a coordinator response's view into the local mirror.
@@ -446,7 +630,7 @@ class Agent:
                     return
                 raise
             try:
-                resp = self._rpc({"cmd": "heartbeat", "rank": self.rank})
+                resp = self._rpc(self._heartbeat_payload())
             except OSError as e:
                 fails += 1
                 if fails >= self.MAX_RPC_FAILURES:
@@ -454,6 +638,9 @@ class Agent:
                         self._coord_down = True
                     obs_spans.instant("elastic.coordinator_lost",
                                       rank=self.rank, failures=fails)
+                    obs_fleet.flight_record(
+                        "coordinator_lost", rank=self.rank, failures=fails,
+                        error=f"{type(e).__name__}: {e}")
                     log.warning(
                         "elastic: rank %d lost the coordinator after %d "
                         "failed heartbeats (%s: %s)", self.rank, fails,
@@ -464,6 +651,12 @@ class Agent:
             self._absorb(resp)
             if resp.get("status") == "rejected":
                 return  # fenced off: no point heartbeating further
+            try:
+                # per-heartbeat clock refinement: one cheap round trip,
+                # kept only if its uncertainty beats the current offset
+                self.sync_clock(rounds=1)
+            except (OSError, ValueError):
+                pass  # the next beat's failure accounting will notice
 
     # -- views + guards --------------------------------------------------
 
@@ -516,6 +709,17 @@ class Agent:
             return self._coord_down
 
     @property
+    def fenced(self) -> bool:
+        """True once the coordinator explicitly rejected this rank as
+        dead: every guard refuses from then on, and the elastic loop
+        must stand down instead of resuming — even when the members
+        list is empty because the survivors already finished and left
+        (the case a membership-only check cannot distinguish from a
+        clean shutdown)."""
+        with self._lock:
+            return self._fenced
+
+    @property
     def silenced(self) -> bool:
         """True once the ``heartbeat_loss`` fault silenced this agent's
         heartbeats (test-observable only): guards deliberately do NOT
@@ -549,6 +753,13 @@ class Agent:
         epoch moves — or if we arrive carrying a stale epoch — and
         `CoordinatorLost` when the coordinator stops answering."""
         fails = 0
+        # arrival/departure instants are the raw material of cross-rank
+        # skew attribution: after trace_merge aligns the clocks, the
+        # spread of `collective.arrive` over ranks decomposes each
+        # collective's cost into "own work" vs "waiting for the slowest"
+        t_arrive = time.perf_counter_ns()
+        obs_spans.instant("collective.arrive", collective=name,
+                          epoch=epoch, rank=self.rank)
         while True:
             # NOT ensure_epoch: whether a barrier at `epoch` still stands
             # is the COORDINATOR's call (a completed barrier is latched —
@@ -582,6 +793,10 @@ class Agent:
             self._absorb(resp)
             status = resp.get("status")
             if status == "go":
+                obs_spans.instant(
+                    "collective.depart", collective=name, epoch=epoch,
+                    rank=self.rank,
+                    wait_ns=time.perf_counter_ns() - t_arrive)
                 return self.view()
             if status in ("epoch_changed", "rejected"):
                 obs_spans.instant("elastic.straggler_rejected"
@@ -685,6 +900,9 @@ def elastic_run(agent: Agent, n_parts: int,
     namespaced by ``run_id``."""
     resumes = 0
     barrier_name = f"{barrier_name}/{run_id}/{n_parts}"
+    if run_id:
+        # exports + flight dumps from here on are namespaced by the run
+        obs_fleet.set_run_id(run_id)
     agent.wait_formed()
     max_iters = 4 * max(agent.view().world, 1) + 8
     with obs_spans.span("elastic.run", rank=agent.rank, n_parts=n_parts):
@@ -698,6 +916,13 @@ def elastic_run(agent: Agent, n_parts: int,
                 view = agent.view()
                 agent.ensure_epoch(view.epoch)  # coordinator/fencing
                 view.require_member(agent.rank)
+                # start rendezvous: every member proves it derived the
+                # SAME epoch before any work dispatches (split-brain at
+                # derivation becomes an ordinary resume, not divergent
+                # slices), and its cross-rank arrival instants anchor
+                # the merged timeline even for runs a straggler never
+                # finishes
+                agent.barrier(f"{barrier_name}/start", view.epoch)
                 sl = ElasticSlice(
                     parts=owned_parts(n_parts, agent.rank, view.members),
                     epoch=view.epoch, world=len(view.members),
@@ -705,9 +930,19 @@ def elastic_run(agent: Agent, n_parts: int,
                 run_parts(sl)
                 agent.barrier(barrier_name, view.epoch)
             except EpochChanged as e:
-                if agent.view().members and \
-                        agent.rank not in agent.view().members:
-                    raise  # we are the straggler: stand down
+                # fencing dominates the membership check: a straggler
+                # whose survivors ALREADY finished and left sees an
+                # empty members list, which must not read as "resume"
+                if agent.fenced or (agent.view().members and
+                                    agent.rank not in agent.view().members):
+                    # we are the straggler: stand down — and leave the
+                    # post-mortem behind (the fenced rank's view of its
+                    # final moments is exactly what the survivor traces
+                    # cannot show)
+                    obs_fleet.flight_record(
+                        "fenced", rank=agent.rank, epoch=agent.epoch,
+                        run_id=run_id or None, fence_reason=e.msg[:200])
+                    raise
                 resumes += 1
                 obs_spans.instant("elastic.resume", rank=agent.rank,
                                   from_epoch=view.epoch,

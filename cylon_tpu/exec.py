@@ -47,6 +47,7 @@ from . import column as colmod
 from . import durable
 from . import resilience
 from . import config
+from .obs import fleet as obs_fleet
 from .obs import metrics as obs_metrics
 from .obs import spans as obs_spans
 from .config import JoinConfig, JoinType
@@ -707,10 +708,22 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
         obs_spans.instant("exec.part_quarantined", part=int(part),
                           level=level, code=st.code.name)
         obs_metrics.counter_add("quarantine.parts")
+        obs_fleet.flight_record("quarantine", part=int(part), level=level,
+                                code=st.code.name, error=msg[:200])
         remaining = remaining[1:]
         part_retries = 0
         fail_key, fail_count = None, 0
         return True
+
+    def fatal(code: Code, msg: str) -> CylonError:
+        """A classified FATAL stream failure (OOM past the split budget,
+        retries/deadline exhausted): dump the flight recorder before the
+        raise so the post-mortem exists even when tracing was never
+        armed."""
+        obs_fleet.flight_record("pass_fatal", code=code.name, level=level,
+                                part=int(remaining[0]) if remaining else None,
+                                error=msg[:200])
+        return CylonError(code, msg)
 
     def recover(e: Exception) -> None:
         """Adjust (remaining, level) for a recoverable failure or raise."""
@@ -754,7 +767,7 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
                        f"{max_splits}): {st.msg}")
                 if quarantine_head(st, msg):
                     return
-                raise CylonError(Code.OutOfMemory, msg) from e
+                raise fatal(Code.OutOfMemory, msg) from e
             # progress check: a split that moves no rows rebuilds an
             # identically-sized program that must OOM again — fail fast
             # instead of burning the whole split budget on no-ops
@@ -767,7 +780,7 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
                        f"range prefix): {st.msg}")
                 if quarantine_head(st, msg):
                     return
-                raise CylonError(Code.OutOfMemory, msg) from e
+                raise fatal(Code.OutOfMemory, msg) from e
             # the FAILING head part may be an atom even when later parts
             # split: allow it ONE split (a smaller output capacity from
             # the other parts can heal an output-driven OOM), then stop.
@@ -785,7 +798,7 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
                            f"range prefix): {st.msg}")
                     if quarantine_head(st, msg):
                         return
-                    raise CylonError(Code.OutOfMemory, msg) from e
+                    raise fatal(Code.OutOfMemory, msg) from e
                 atom_watch.clear()
                 atom_watch.update((head, head + plan.part_count(level)))
             else:
@@ -809,7 +822,7 @@ def _stream_recoverable(make_exec, plan, t0, *, policy=None, stats=None,
                        f"attempts: {st.msg}")
                 if quarantine_head(st, msg):
                     return
-                raise CylonError(st.code, msg) from e
+                raise fatal(st.code, msg) from e
             d = policy.delay(part_retries)
             part_retries += 1
             stats["retries"] = stats.get("retries", 0) + 1

@@ -17,6 +17,7 @@ span boundaries), ``CYLON_TPU_TRACE_DIR``, ``CYLON_TPU_TRACE_BUFFER_CAP``.
 from __future__ import annotations
 
 from . import export  # noqa: F401
+from . import fleet  # noqa: F401
 from . import metrics  # noqa: F401
 from . import spans  # noqa: F401
 from .spans import instant, span  # noqa: F401

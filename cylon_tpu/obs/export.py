@@ -21,6 +21,7 @@ import sys
 from typing import Dict, Optional, Tuple
 
 from .. import config
+from . import fleet as fleet_mod
 from . import metrics as metrics_mod
 from . import spans as spans_mod
 
@@ -31,8 +32,16 @@ def trace_dir() -> str:
 
 
 def default_rank() -> int:
-    """This process's mesh rank for artifact naming: ``jax.process_index``
-    when jax is up (multi-host meshes), else 0."""
+    """This process's mesh rank for artifact naming.
+
+    The fleet identity (``obs.fleet.set_rank``, installed by the elastic
+    agent at join) wins: every single-controller process reports
+    ``jax.process_index() == 0``, so two elastic agents consulting jax
+    alone would BOTH export ``trace.r0.json`` and clobber each other.
+    Then ``jax.process_index`` (genuine multi-host meshes), then 0."""
+    r = fleet_mod.current_rank()
+    if isinstance(r, int):
+        return r
     jax = sys.modules.get("jax")
     if jax is None:
         return 0
@@ -53,6 +62,12 @@ def _artifact_path(path: Optional[str], prefix: str,
     r = default_rank() if rank is None else int(rank)
     d = trace_dir()
     os.makedirs(d, exist_ok=True)
+    rid = fleet_mod.current_run_id()
+    if rid:
+        # run-id namespacing: back-to-back runs sharing one trace dir
+        # (or two elastic runs on one host) never clobber
+        return os.path.join(
+            d, f"{prefix}.{fleet_mod._safe_component(rid)}.r{r}.json")
     return os.path.join(d, f"{prefix}.r{r}.json")
 
 
@@ -85,6 +100,10 @@ def export_trace(path: Optional[str] = None, *, rank: Optional[int] = None,
             "producer": "cylon_tpu.obs",
             "rank": pid,
             "dropped_events": spans_mod.dropped(),
+            # clock alignment (obs.fleet): lets tools/trace_merge.py lay
+            # this rank's monotonic timestamps onto the coordinator clock
+            "run_id": fleet_mod.current_run_id(),
+            "clock": fleet_mod.clock_dict(),
         },
     }
     with open(out_path, "w", encoding="utf-8") as fh:
@@ -101,6 +120,8 @@ def export_metrics(path: Optional[str] = None, *, rank: Optional[int] = None,
     doc = dict(metrics_mod.snapshot())
     doc["rank"] = default_rank() if rank is None else int(rank)
     doc["dropped_events"] = spans_mod.dropped()
+    doc["run_id"] = fleet_mod.current_run_id()
+    doc["clock"] = fleet_mod.clock_dict()
     with open(out_path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, default=str, sort_keys=True)
     return out_path

@@ -37,6 +37,7 @@ import logging
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 from .. import config
@@ -71,6 +72,13 @@ _totals: Dict[str, float] = {}
 _counts: Dict[str, int] = {}
 _tls = threading.local()
 
+# flight-recorder ring: the most recent events, kept in EVERY enabled
+# mode (aggregate included) so a terminal-event dump (obs.fleet) has
+# context even when the user never armed CYLON_TPU_TRACE=1.  Unlike the
+# export buffer it overwrites oldest-first — a post-mortem wants the
+# events LEADING UP to the failure, not the run's first N.
+_ring: "deque[Event]" = deque(maxlen=512)
+
 # CYLON_TPU_DEBUG log-on-exit (the PR-0 utils.timing behavior, preserved
 # through the shim): initialized from the knob, flipped by enable_log()
 _log_enabled = bool(config.knob("CYLON_TPU_DEBUG"))
@@ -96,6 +104,26 @@ def sync_enabled() -> bool:
 
 def buffer_cap() -> int:
     return max(1, int(config.knob("CYLON_TPU_TRACE_BUFFER_CAP")))
+
+
+def ring_cap() -> int:
+    """``CYLON_TPU_FLIGHT_RING_CAP``: flight-recorder ring size (0 off)."""
+    return max(0, int(config.knob("CYLON_TPU_FLIGHT_RING_CAP")))
+
+
+def _ring_record(ev: Event) -> None:
+    global _ring
+    cap = ring_cap()
+    if cap <= 0:
+        return
+    if _ring.maxlen != cap:
+        _ring = deque(_ring, maxlen=cap)
+    _ring.append(ev)
+
+
+def ring_events() -> Tuple[Event, ...]:
+    """Snapshot of the flight-recorder ring, oldest first."""
+    return tuple(_ring)
 
 
 def enable_log(on: bool = True) -> None:
@@ -156,14 +184,15 @@ _NULL = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "_t0", "_d", "_buffer", "_sync")
+    __slots__ = ("name", "attrs", "_t0", "_d", "_buffer", "_sync", "_ring")
 
     def __init__(self, name: str, attrs: Optional[Dict[str, object]],
-                 buffer: bool, sync: bool):
+                 buffer: bool, sync: bool, ring: bool):
         self.name = name
         self.attrs = attrs
         self._buffer = buffer
         self._sync = sync
+        self._ring = ring
 
     def set(self, **attrs) -> "_Span":
         """Attach/refresh attributes after entry (e.g. a row count known
@@ -189,9 +218,13 @@ class _Span:
         dur = t1 - self._t0
         _totals[self.name] = _totals.get(self.name, 0.0) + dur * 1e-9
         _counts[self.name] = _counts.get(self.name, 0) + 1
-        if self._buffer:
-            _record(Event(self.name, self._t0, dur,
-                          threading.get_ident(), self._d, "X", self.attrs))
+        if self._buffer or self._ring:
+            ev = Event(self.name, self._t0, dur,
+                       threading.get_ident(), self._d, "X", self.attrs)
+            if self._buffer:
+                _record(ev)
+            if self._ring:
+                _ring_record(ev)
         if _log_enabled:
             log.info("%s took %.3f ms", self.name, dur * 1e-6)
         return False
@@ -207,9 +240,10 @@ def span(name: str, **attrs):
     m = mode()
     if m == OFF:
         return _NULL
-    # the sync knob resolves ONCE per span, not per boundary, so
+    # the sync/ring knobs resolve ONCE per span, not per boundary, so
     # enter/exit stay at two perf_counter reads and two dict updates
-    return _Span(name, attrs or None, m == EVENTS, sync_enabled())
+    return _Span(name, attrs or None, m == EVENTS, sync_enabled(),
+                 ring_cap() > 0)
 
 
 def instant(name: str, **attrs) -> None:
@@ -221,9 +255,12 @@ def instant(name: str, **attrs) -> None:
         return
     _counts[name] = _counts.get(name, 0) + 1
     _totals.setdefault(name, 0.0)
-    if m == EVENTS:
-        _record(Event(name, time.perf_counter_ns(), 0,
-                      threading.get_ident(), _depth(), "i", attrs or None))
+    if m == EVENTS or ring_cap() > 0:
+        ev = Event(name, time.perf_counter_ns(), 0,
+                   threading.get_ident(), _depth(), "i", attrs or None)
+        if m == EVENTS:
+            _record(ev)
+        _ring_record(ev)
 
 
 def events() -> Tuple[Event, ...]:
@@ -252,8 +289,10 @@ def reset_aggregates() -> None:
 
 
 def reset() -> None:
-    """Clear the event buffer, the drop counter and the aggregates."""
+    """Clear the event buffer, the flight ring, the drop counter and the
+    aggregates."""
     global _dropped
     _events.clear()
+    _ring.clear()
     _dropped = 0
     reset_aggregates()

@@ -56,6 +56,12 @@ from .status import Code, CylonError, Status
 RETRYABLE_CODES = frozenset({Code.ExecutionError, Code.Timeout})
 
 
+def fault_delay_s() -> float:
+    """Sleep injected by the ``delay`` fault kind
+    (``CYLON_TPU_FAULT_DELAY_S``)."""
+    return max(0.0, float(config.knob("CYLON_TPU_FAULT_DELAY_S")))
+
+
 def max_oom_splits() -> int:
     """How many times the engine may double the pass count before a device
     OOM becomes fatal (``CYLON_TPU_MAX_OOM_SPLITS``, default 4 — a 16x
@@ -175,6 +181,11 @@ _KIND_MESSAGES = {
     # last-opened journal's spill files while KEEPING the manifest — the
     # GC-eviction-races-a-reader window the result cache must survive by
     # re-executing, never by serving a torn journal
+    # fleet-observability kind (PR 8): `delay` sleeps the probe for
+    # CYLON_TPU_FAULT_DELAY_S and continues — a seeded straggler that
+    # keeps heartbeating and computing correctly but arrives late at
+    # every collective, so skew attribution has a known culprit
+    "delay": "injected delay at {site} (hit {hit})",
     "tenant_flood": ("RESOURCE_EXHAUSTED: injected tenant flood at {site} "
                      "(hit {hit}): admission budget exceeded"),
     "shed": ("UNAVAILABLE: injected shed at {site} (hit {hit}): "
@@ -327,6 +338,9 @@ def fault_point(site: str) -> None:
             from . import durable
 
             time.sleep(max(1.5 * durable.deadline_s(), 0.05))
+            return
+        if kind == "delay":
+            time.sleep(fault_delay_s())
             return
         raise InjectedFault(site, kind, plan.hits[site])
 

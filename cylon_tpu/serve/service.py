@@ -69,6 +69,7 @@ from .. import config
 from .. import durable
 from .. import exec as exec_mod
 from .. import resilience
+from ..obs import fleet as obs_fleet
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
 from ..status import Code, CylonError, Status
@@ -105,6 +106,25 @@ def tenant_quarantine_s() -> float:
 
 # the ctor's ``queue_cap=`` parameter shadows the accessor's name
 _default_queue_cap = queue_cap
+
+
+def _slo_tenant(tenant: str) -> str:
+    """The tenant id as spelled inside an SLO histogram key: brackets
+    are remapped because every parser of these keys (``telemetry``,
+    tools/trace_report.py ``slo_rows``) splits on the first ``[`` and
+    strips one trailing ``]`` — a raw ``t[1]`` would silently vanish
+    from the SLO view."""
+    return tenant.replace("[", "(").replace("]", ")")
+
+
+def _slo_key(kind: str, tenant: str) -> str:
+    """Metric key of one tenant's SLO latency histogram:
+    ``serve.<kind>[<tenant>]`` — kind is ``queue_wait_ms`` (admission to
+    dispatch) or ``run_ms`` (dispatch to terminal).  Consumers split on
+    the first ``[``; tools/trace_report.py renders these as the
+    per-tenant SLO table and the elastic coordinator aggregates them
+    fleet-wide in its ``status`` verb."""
+    return f"serve.{kind}[{_slo_tenant(tenant)}]"
 
 
 # ---------------------------------------------------------------------------
@@ -158,6 +178,8 @@ class Ticket:
         self.error: Optional[CylonError] = None
         self.cache_hit = False
         self.duration_s: Optional[float] = None
+        self.queue_wait_s: Optional[float] = None
+        self.t_submit = time.perf_counter()
         self._event = threading.Event()
         self._cancel = threading.Event()
 
@@ -267,6 +289,7 @@ class QueryService:
         self._draining = False
         self._closed = False
         self._ewma_s: Optional[float] = None
+        self._pending_flight: List[dict] = []  # staged shed dumps
         self._counts = {"admitted": 0, "shed": 0, "completed": 0,
                         "failed": 0, "cancelled": 0, "cache_hits": 0,
                         "tenants_quarantined": 0}
@@ -309,10 +332,28 @@ class QueryService:
         obs_metrics.counter_add("serve.shed")
         obs_spans.instant("serve.shed", tenant=tenant, code=code.name,
                           reason=reason)
+        # a shed is a classified terminal event for the caller: the
+        # flight dump records the admission state that forced it —
+        # STAGED here (every _shed call site holds the service lock) and
+        # written by _flush_flight after release, so disk latency never
+        # serializes admission under the exact overload being recorded
+        self._pending_flight.append(dict(
+            tenant=tenant, code=code.name, shed_reason=reason,
+            queue_depth=len(self._queue)))
         hint = "" if retry_after is None else f"; retry after ~{retry_after:.2f}s"
         return CylonError(code, f"request shed for tenant {tenant!r}: "
                                 f"{reason}{hint}",
                           retry_after_s=retry_after)
+
+    def _flush_flight(self) -> None:
+        """Write the shed dumps `_shed` staged under the service lock,
+        OUTSIDE it — host-side file IO only, never device work."""
+        while True:
+            with self._lock:
+                if not self._pending_flight:
+                    return
+                kw = self._pending_flight.pop(0)
+            obs_fleet.flight_record("shed", **kw)
 
     def submit(self, tenant: str, op: str, *args, **kwargs) -> Ticket:
         """Admit one table op (``op`` in :data:`OPS`; ``args``/``kwargs``
@@ -320,6 +361,13 @@ class QueryService:
         classified `CylonError` carrying ``retry_after_s``.  Runs
         entirely on the caller's thread and never blocks on the device
         or the queue."""
+        try:
+            return self._submit_inner(tenant, op, *args, **kwargs)
+        finally:
+            self._flush_flight()  # staged shed dumps, lock released
+
+    def _submit_inner(self, tenant: str, op: str, *args,
+                      **kwargs) -> Ticket:
         tenant = str(tenant)
         if op not in _RUNNERS:
             raise CylonError(Code.Invalid,
@@ -419,6 +467,12 @@ class QueryService:
         device work on this path (cylint CY107): a wedged device must
         never block shedding or drain.  Returns a ticket, None (nothing
         actionable this tick), or ``_STOP``."""
+        try:
+            return self._dispatch_inner()
+        finally:
+            self._flush_flight()
+
+    def _dispatch_inner(self):
         with self._lock:
             while not self._queue:
                 if self._closed:
@@ -500,6 +554,13 @@ class QueryService:
 
         ticket.state = RUNNING
         t0 = time.perf_counter()
+        # the SLO split: how long the request sat admitted (queue wait)
+        # vs how long it ran — recorded for every dispatched request,
+        # succeed or fail, so the histograms describe the service's
+        # latency, not just its successes
+        ticket.queue_wait_s = max(0.0, t0 - ticket.t_submit)
+        obs_metrics.hist_observe(_slo_key("queue_wait_ms", tenant),
+                                 ticket.queue_wait_s * 1e3)
         runner = _RUNNERS[ticket.op]
         with obs_spans.span("serve.request", tenant=tenant,
                             op=ticket.op) as sp:
@@ -514,6 +575,8 @@ class QueryService:
             finally:
                 dur = time.perf_counter() - t0
                 ticket.duration_s = dur
+                obs_metrics.hist_observe(_slo_key("run_ms", tenant),
+                                         dur * 1e3)
                 if obs_spans.events_enabled():
                     sp.set(seconds=round(dur, 6), state=ticket.state)
         hit = cache_mod.served_from_journal(stats)
@@ -566,6 +629,13 @@ class QueryService:
             obs_spans.instant("serve.tenant_quarantined",
                               tenant=ticket.tenant, streak=st.streak,
                               code=err.code.name)
+        # classified terminal failure (deadline overruns included): the
+        # flight dump carries the ring + metrics so the post-mortem does
+        # not depend on the caller having pre-armed tracing
+        obs_fleet.flight_record("request_failed", tenant=ticket.tenant,
+                                op=ticket.op, code=err.code.name,
+                                quarantined=quarantined,
+                                error=err.msg[:200])
         ticket._finish(FAILED, error=err)
 
     # -- drain / close ------------------------------------------------------
@@ -594,6 +664,7 @@ class QueryService:
                 if rem == 0.0:
                     break
                 self._lock.wait(rem if rem is not None else 0.1)
+        self._flush_flight()
         return shed
 
     def close(self, timeout: Optional[float] = 60.0) -> None:
@@ -609,6 +680,51 @@ class QueryService:
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    #: largest tenant set one telemetry payload carries — tenant ids are
+    #: caller-supplied strings, and an unbounded set would bloat every
+    #: heartbeat and eventually overflow the status reply; the busiest
+    #: tenants win, the rest are counted in ``tenants_omitted``
+    TELEMETRY_MAX_TENANTS = 64
+
+    def telemetry(self) -> dict:
+        """Control-plane telemetry for the fleet status endpoint: queue
+        depth plus per-tenant counters and SLO latency histograms
+        (queue-wait vs run split).  Attach to an elastic agent
+        (``agent.attach_telemetry(svc.telemetry)``) and the coordinator
+        aggregates it across ranks in its ``status`` verb.  Host-only —
+        a snapshot of already-recorded metrics, never device work.
+
+        Scoped to THIS service's tenants (the metrics registry is
+        process-global, and a second QueryService in the process must
+        not double-report the first one's histograms) and bounded to the
+        ``TELEMETRY_MAX_TENANTS`` busiest tenants."""
+        with self._lock:
+            depth = len(self._queue)
+            mine = {t: dict(served=s.served, shed=s.shed, failed=s.failed,
+                            cache_hits=s.cache_hits)
+                    for t, s in sorted(self._tenants.items())}
+        omitted = 0
+        if len(mine) > self.TELEMETRY_MAX_TENANTS:
+            busiest = sorted(
+                mine, key=lambda t: -(mine[t]["served"] + mine[t]["shed"]
+                                      + mine[t]["failed"]))
+            omitted = len(mine) - self.TELEMETRY_MAX_TENANTS
+            mine = {t: mine[t]
+                    for t in sorted(busiest[:self.TELEMETRY_MAX_TENANTS])}
+        tenants: Dict[str, dict] = dict(mine)
+        by_slo_name = {_slo_tenant(t): t for t in tenants}
+        for key, h in obs_metrics.snapshot()["histograms"].items():
+            if not key.startswith("serve.") or "[" not in key:
+                continue
+            kind, t = key[len("serve."):].split("[", 1)
+            t = by_slo_name.get(t.rstrip("]"))
+            if t is not None:
+                tenants[t][kind] = h
+        out = {"queue_depth": depth, "tenants": tenants}
+        if omitted:
+            out["tenants_omitted"] = omitted
+        return out
 
     def stats(self) -> dict:
         """Deterministic service report: the artifact the serve smoke and

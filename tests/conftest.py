@@ -56,6 +56,17 @@ def pytest_configure(config):
         "markers", "slow: large-scale property tests (~1M rows/shard)")
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _trace_dir_isolation(tmp_path_factory):
+    """Point CYLON_TPU_TRACE_DIR at a session tmp dir unless the caller
+    set one: the flight recorder (obs.fleet) auto-dumps on classified
+    terminal events — which fault-injection tests fire constantly — and
+    those dumps must not accumulate under the repo's default ./traces."""
+    if not os.environ.get("CYLON_TPU_TRACE_DIR"):
+        os.environ["CYLON_TPU_TRACE_DIR"] = str(
+            tmp_path_factory.mktemp("obs_traces"))
+
+
 @pytest.fixture(scope="session")
 def local_ctx():
     from cylon_tpu.context import CylonContext

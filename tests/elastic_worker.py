@@ -34,6 +34,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from cylon_tpu import elastic  # noqa: E402
 from cylon_tpu.exec import chunked_join_groupby_tables  # noqa: E402
+from cylon_tpu.obs import export as obs_export  # noqa: E402
+from cylon_tpu.obs import spans as obs_spans  # noqa: E402
 
 N_ROWS = 3000
 N_PASSES = 6
@@ -64,6 +66,20 @@ def run_op(left, right, sl=None):
         mode="hash", elastic=sl)
 
 
+def _export_trace(rank: int) -> None:
+    """Ship this rank's event buffer when tracing is armed (the fleet
+    identity set by the agent names the artifact, the elastic run id
+    namespaces it) — on EVERY exit path: a fenced straggler's trace is
+    exactly what the survivors' traces cannot show."""
+    if not obs_spans.events_enabled():
+        return
+    try:
+        tp, _ = obs_export.export_all()
+        print(f"rank {rank}: trace exported to {tp}", flush=True)
+    except OSError as e:
+        print(f"rank {rank}: trace export failed: {e}", flush=True)
+
+
 def main() -> int:
     rank, world = int(sys.argv[1]), int(sys.argv[2])
     address, out_path, stats_path = sys.argv[3], sys.argv[4], sys.argv[5]
@@ -80,9 +96,11 @@ def main() -> int:
             run_id=f"seed{seed}")
     except elastic.CoordinatorLost as e:
         print(f"rank {rank}: coordinator lost: {e}", flush=True)
+        _export_trace(rank)
         return 3
     except elastic.EpochChanged as e:
         print(f"rank {rank}: fenced as straggler: {e}", flush=True)
+        _export_trace(rank)
         return 4
     res, stats = final
     order = np.argsort(res["l_k"], kind="stable")
@@ -93,6 +111,7 @@ def main() -> int:
                    **{k: v for k, v in stats.items()
                       if isinstance(v, (int, float, str, list))}}, fh)
     agent.leave()
+    _export_trace(rank)
     print(f"rank {rank}/{world} OK: epoch={agent.epoch} "
           f"skipped={stats.get('passes_skipped')}", flush=True)
     return 0

@@ -585,3 +585,70 @@ def test_gc_runs_after_service_requests(tmp_path):
     assert len(runs) == 1
     assert obs_metrics.counter_value("durable.gc_runs_evicted") >= 1
     obs_metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant SLO latency histograms (PR 8: queue-wait vs run split)
+# ---------------------------------------------------------------------------
+
+def test_per_tenant_slo_latency_histograms():
+    """Every dispatched request records its queue wait (admission to
+    dispatch) and run time into per-tenant histograms — the rows the
+    fleet status endpoint aggregates and trace_report renders.  Counts
+    are deterministic; the snapshot serializes identically across
+    recording orders (the obs.metrics contract)."""
+    obs_metrics.reset()
+    left, right = _inputs(70, n=600)
+    with QueryService() as svc:
+        for _ in range(2):
+            svc.submit("slo-a", "join", left, right, on="k", passes=1,
+                       mode="hash").result(timeout=WAIT_S)
+        tb = svc.submit("slo-b", "join", left, right, on="k", passes=1,
+                        mode="hash")
+        tb.result(timeout=WAIT_S)
+        tel = svc.telemetry()
+    h = obs_metrics.snapshot()["histograms"]
+    qa, ra = h["serve.queue_wait_ms[slo-a]"], h["serve.run_ms[slo-a]"]
+    assert qa["count"] == 2 and ra["count"] == 2
+    assert h["serve.queue_wait_ms[slo-b]"]["count"] == 1
+    assert h["serve.run_ms[slo-b]"]["count"] == 1
+    assert qa["min"] >= 0 and ra["min"] > 0
+    assert ra["sum"] >= ra["max"] >= ra["min"]
+    # the ticket carries the same split
+    assert tb.queue_wait_s is not None and tb.queue_wait_s >= 0
+    assert tb.duration_s is not None and tb.duration_s > 0
+    # telemetry: the exact rows the coordinator status verb aggregates
+    assert tel["queue_depth"] == 0
+    a = tel["tenants"]["slo-a"]
+    assert a["served"] == 2 and a["queue_wait_ms"]["count"] == 2
+    assert a["run_ms"]["count"] == 2
+    assert tel["tenants"]["slo-b"]["served"] == 1
+    # telemetry is scoped to the SERVICE, not the process-global metrics
+    # registry: a second service must not report the first one's tenants
+    with QueryService() as svc2:
+        assert svc2.telemetry()["tenants"] == {}
+    obs_metrics.reset()
+
+
+def test_slo_histograms_record_failures_too(monkeypatch):
+    """The run histogram describes the service's latency, not just its
+    successes: a failing request still lands a run_ms observation (its
+    time on the mesh was real), and queue-wait is recorded at
+    dispatch."""
+    obs_metrics.reset()
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("UNAVAILABLE: injected runner failure")
+
+    monkeypatch.setitem(service_mod._RUNNERS, "join", boom)
+    left, right = _inputs(71, n=200)
+    with config.knob_env(CYLON_TPU_SERVE_QUARANTINE_AFTER="0"):
+        with QueryService() as svc:
+            t = svc.submit("slo-f", "join", left, right, on="k", passes=1,
+                           mode="hash")
+            with pytest.raises(CylonError):
+                t.result(timeout=WAIT_S)
+    h = obs_metrics.snapshot()["histograms"]
+    assert h["serve.queue_wait_ms[slo-f]"]["count"] == 1
+    assert h["serve.run_ms[slo-f]"]["count"] == 1
+    obs_metrics.reset()

@@ -1,0 +1,130 @@
+"""Render the elastic coordinator's live ``status`` verb.
+
+One request to the coordinator's control port (the same one-shot
+JSON-over-TCP protocol the agents speak) returns the fleet's ground
+truth while a run is in flight: membership + epoch, per-rank heartbeat
+ages and clock offsets (the alignment trace_merge uses), the recent
+per-collective skew ledger (slowest-rank attribution on the
+coordinator's own clock), and the aggregated serving view — total queue
+depth plus per-tenant SLO latency histograms (queue-wait vs run split)
+merged across every rank's heartbeat telemetry.
+
+Pure stdlib (no jax, no package import) so it runs anywhere a socket
+reaches the coordinator.
+
+Usage:
+    python tools/fleet_status.py HOST:PORT [--json] [--timeout S]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+from typing import Dict
+
+
+def request(address: str, obj: Dict, timeout: float = 5.0) -> Dict:
+    """One JSON request/response round trip (the net/control.py wire
+    format, re-implemented so the tool stays dependency-free)."""
+    host, _, port = address.rpartition(":")
+    if not host or not port:
+        raise ValueError(f"bad coordinator address {address!r} "
+                         f"(want host:port)")
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        sock.sendall(json.dumps(obj, sort_keys=True).encode() + b"\n")
+        buf = bytearray()
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("coordinator closed mid-reply")
+            buf.extend(chunk)
+            if len(buf) > (1 << 20):
+                raise ConnectionError("status reply exceeds 1 MiB")
+    return json.loads(buf.decode())
+
+
+def _hist_line(h: Dict) -> str:
+    n = int(h.get("count", 0))
+    if n == 0:
+        return "      -"
+    mean = float(h.get("sum", 0.0)) / n
+    return (f"n={n:<5d} mean={mean:8.1f}ms  "
+            f"max={float(h.get('max') or 0.0):8.1f}ms")
+
+
+def render(st: Dict) -> str:
+    lines = []
+    lines.append(f"epoch {st.get('epoch')}  members {st.get('members')}  "
+                 f"world {st.get('world')}")
+    dead = st.get("dead") or {}
+    if dead:
+        lines.append("dead: " + ", ".join(
+            f"r{r} ({why})" for r, why in sorted(dead.items())))
+    ranks = st.get("ranks") or {}
+    if ranks:
+        lines.append("\nranks:")
+        lines.append(f"  {'rank':>4s} {'hb age':>8s} {'clock offset':>14s} "
+                     f"{'uncertainty':>12s}")
+        for r, row in sorted(ranks.items(), key=lambda kv: int(kv[0])):
+            c = row.get("clock")
+            off = f"{c['offset_ns'] / 1e3:12.1f}us" if c else "           -"
+            unc = (f"{c['uncertainty_ns'] / 1e3:10.1f}us" if c
+                   else "         -")
+            lines.append(f"  {r:>4s} {row.get('hb_age_s', 0):7.2f}s "
+                         f"{off:>14s} {unc:>12s}")
+    serve = st.get("serve") or {}
+    tenants = serve.get("tenants") or {}
+    lines.append(f"\nserve queue depth: {serve.get('queue_depth', 0)}")
+    if tenants:
+        lines.append("per-tenant SLO (aggregated across ranks):")
+        for t, row in sorted(tenants.items()):
+            lines.append(f"  {t}: served={row.get('served', 0)} "
+                         f"shed={row.get('shed', 0)} "
+                         f"failed={row.get('failed', 0)} "
+                         f"cache_hits={row.get('cache_hits', 0)}")
+            for kind, label in (("queue_wait_ms", "queue wait"),
+                                ("run_ms", "run       ")):
+                h = row.get(kind)
+                if isinstance(h, dict):
+                    lines.append(f"      {label}  {_hist_line(h)}")
+    colls = st.get("collectives") or []
+    if colls:
+        lines.append("\nrecent collectives (coordinator-clock skew):")
+        for c in colls[-10:]:
+            lines.append(f"  {c.get('collective', '?')[:44]:44s} "
+                         f"epoch {c.get('epoch')}  "
+                         f"skew {c.get('skew_ns', 0) / 1e6:8.3f}ms  "
+                         f"slowest r{c.get('slowest_rank')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet_status",
+        description="live status of an elastic coordinator (membership, "
+                    "clocks, heartbeats, serve SLO, collective skew)")
+    ap.add_argument("address", help="coordinator host:port "
+                                    "(CYLON_TPU_ELASTIC_COORD)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true",
+                    help="raw status JSON on stdout")
+    args = ap.parse_args(argv)
+    try:
+        st = request(args.address, {"cmd": "status"}, timeout=args.timeout)
+    except (OSError, ValueError) as e:
+        print(f"fleet_status: coordinator unreachable at {args.address}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(st, sys.stdout, indent=1, sort_keys=True)
+        print()
+        return 0
+    print(render(st))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -160,6 +160,104 @@ if [ $rc -ne 0 ]; then
   echo "elastic kill-one-resume smoke failed (rc=$rc); fix elastic membership before the full tree" >&2
   exit $rc
 fi
+# fleet-observability smoke (ISSUE-8): a 2-process elastic run with a
+# heartbeat_loss straggler (rank 1 goes silent AND drags a seeded delay)
+# must leave per-rank clock-aligned traces that trace_merge combines
+# into one schema-valid timeline with nonzero cross-rank skew, plus a
+# flight-recorder dump for the fenced rank and a rank-loss dump from the
+# coordinator — with CYLON_TPU_TRACE only armed for the workers, never
+# needed for the flight dumps
+FT=$(mktemp -d /tmp/cylon_fleet_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_TRACE_DIR="$FT/traces" \
+    python - "$FT" <<'PYEOF'
+import json, os, subprocess, sys, tempfile
+
+sys.path.insert(0, os.getcwd())
+from cylon_tpu import elastic
+
+td = sys.argv[1]
+coord = elastic.Coordinator(2, heartbeat_timeout_s=0.8).start()
+addr = f"{coord.address[0]}:{coord.address[1]}"
+base_env = {k: v for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS",
+                         "CYLON_TPU_FAULT_PLAN", "CYLON_TPU_DURABLE_DIR")}
+base_env.update(CYLON_TPU_DURABLE_DIR=os.path.join(td, "journal"),
+                CYLON_TPU_HEARTBEAT_S="0.1",
+                CYLON_TPU_HEARTBEAT_TIMEOUT_S="0.8",
+                CYLON_TPU_TRACE="1",
+                CYLON_TPU_TRACE_DIR=os.path.join(td, "traces"))
+procs = []
+for r in range(2):
+    env = dict(base_env)
+    if r == 1:
+        # silent straggler + seeded per-pass delay: fenced, late, traced
+        env["CYLON_TPU_FAULT_PLAN"] = \
+            "elastic.heartbeat.r1@2=heartbeat_loss;elastic.pass.r1@1+=delay"
+        env["CYLON_TPU_FAULT_DELAY_S"] = "1.0"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "tests.elastic_worker", str(r), "2", addr,
+         os.path.join(td, f"out_r{r}.npz"),
+         os.path.join(td, f"stats_r{r}.json")], env=env))
+try:
+    for p in procs:
+        p.wait(timeout=240)
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    coord.stop()
+assert procs[0].returncode == 0, procs[0].returncode
+assert procs[1].returncode == 4, procs[1].returncode  # fenced straggler
+# the coordinator (this process) dumped the rank loss
+flight = os.path.join(td, "traces", "flight")
+dumps = os.listdir(flight)
+assert any(f.endswith(".rcoord.json") for f in dumps), dumps
+# the fenced rank dumped its own post-mortem, run-id namespaced
+fenced = json.load(open(os.path.join(flight, "seed7.r1.json")))
+assert fenced["kind"] == "cylon_tpu.flight", fenced["kind"]
+assert fenced["reason"] == "fenced", fenced["reason"]
+assert fenced["rank"] == 1 and fenced["traceEvents"], "empty fenced dump"
+print(f"fleet smoke: workers ok (r0=0, r1=fenced), "
+      f"flight dumps: {sorted(dumps)}")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "fleet obs smoke (run) failed (rc=$rc); fix fleet observability before the full tree" >&2
+  rm -rf "$FT"; exit $rc
+fi
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/trace_merge.py "$FT/traces" -o "$FT/merged.json" --json \
+    > "$FT/merge_summary.json" \
+  && python - "$FT" <<'PYEOF'
+import json, sys
+td = sys.argv[1]
+summary = json.load(open(f"{td}/merge_summary.json"))
+assert summary["ranks"] == [0, 1], summary["ranks"]
+assert summary["aligned"] is True, summary
+assert summary["dropped_events"] == 0, summary
+# merged file re-validates against the Chrome-trace schema
+merged = json.load(open(f"{td}/merged.json"))
+for e in merged["traceEvents"]:
+    if e["ph"] == "M":
+        continue
+    assert all(k in e for k in ("name", "ph", "ts", "pid", "tid")), e
+    assert e["ph"] != "X" or "dur" in e, e
+# nonzero cross-rank skew on the run's rendezvous (both ranks arrived
+# at the epoch-0 start barrier before the straggler was fenced)
+rows = [r for r in summary["collectives"] if len(r["ranks"]) == 2]
+assert rows, summary["collectives"]
+assert any(r["skew_us"] > 0 for r in rows), rows
+print(f"fleet smoke ok: merged {len(merged['traceEvents'])} events, "
+      f"{len(rows)} cross-rank collective(s), "
+      f"max skew {max(r['skew_us'] for r in rows) / 1e3:.3f}ms")
+PYEOF
+rc=$?
+rm -rf "$FT"
+if [ $rc -ne 0 ]; then
+  echo "fleet obs smoke (merge) failed (rc=$rc); fix trace_merge before the full tree" >&2
+  exit $rc
+fi
 # serve smoke (ISSUE-7): flood a 2-tenant query service against a
 # single-slot admission queue — overload must resolve as classified
 # sheds + exact serves (never a hang), and a repeated query must hit

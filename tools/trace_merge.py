@@ -1,0 +1,354 @@
+"""Merge per-rank cylon_tpu.obs traces into ONE Perfetto timeline on an
+aligned clock, with per-collective skew attribution.
+
+Each rank's trace carries timestamps from its own ``perf_counter_ns``
+(arbitrary zero per process) plus the clock-alignment block the elastic
+agent measured against the coordinator (``otherData.clock``:
+offset/uncertainty, obs.fleet).  This tool maps every rank onto the
+coordinator clock (``ts' = ts + offset``), assigns one Perfetto ``pid``
+per rank, and emits a single schema-valid Chrome-trace JSON — REFUSING
+to merge when any rank's offset uncertainty exceeds the requested
+resolution (``--max-uncertainty-us``): a merged timeline whose cross-
+rank ordering is noise would be worse than no timeline.
+
+It also decomposes collective time the way the MPI characterization
+literature says is debuggable (arxiv 1810.11112): per (collective,
+epoch), the spread of the ranks' ``collective.arrive`` instants is the
+SKEW — everyone pays for the slowest participant — and each rank's
+``last_arrival - own_arrival`` is the wait it imposed/absorbed.  The
+slowest rank is named per collective.
+
+Pure stdlib + JSON (no jax, no package import), like trace_report.
+
+Usage:
+    python tools/trace_merge.py TRACE.r0.json TRACE.r1.json ... [-o OUT]
+    python tools/trace_merge.py TRACE_DIR [--json] [--force]
+                                [--max-uncertainty-us US]
+
+Exit codes: 0 merged; 2 refused (uncertainty/clock-reference problems —
+``--force`` overrides, marking the output as unaligned-best-effort).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+class MergeError(Exception):
+    """The traces cannot be merged faithfully (exit 2)."""
+
+
+def load_trace(path: str) -> Dict[str, object]:
+    """Load and validate a Chrome-trace export (schema contract shared
+    with ``cylon_tpu.obs.export.load_trace``, duplicated so the tool
+    stays pure-JSON)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        raise ValueError(f"{path}: not a Chrome-trace export "
+                         f"(missing traceEvents list)")
+    for ev in evs:
+        for k in ("name", "ph", "ts", "pid", "tid"):
+            if k not in ev:
+                raise ValueError(f"{path}: event missing {k!r}: {ev}")
+        if ev["ph"] == "X" and "dur" not in ev:
+            raise ValueError(f"{path}: complete event missing dur: {ev}")
+    return doc
+
+
+def rank_of(doc: Dict, path: str) -> int:
+    other = doc.get("otherData", {})
+    if isinstance(other.get("rank"), int):
+        return other["rank"]
+    m = re.search(r"\.r(\d+)\.json$", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    raise ValueError(f"{path}: cannot determine rank "
+                     f"(no otherData.rank, no .rN.json suffix)")
+
+
+def discover(inputs: List[str]) -> List[str]:
+    """Expand directories into their per-rank trace files (metrics and
+    flight artifacts excluded)."""
+    paths: List[str] = []
+    for inp in inputs:
+        if os.path.isdir(inp):
+            for p in sorted(glob.glob(os.path.join(inp, "*.r*.json"))):
+                base = os.path.basename(p)
+                # skip metrics artifacts under BOTH namings: the
+                # export_all sibling (prefix.metrics.rN.json) and the
+                # plain export_metrics default (metrics.rN.json)
+                if ".metrics." in base or base.startswith("metrics."):
+                    continue
+                paths.append(p)
+        else:
+            paths.append(inp)
+    if not paths:
+        raise MergeError(f"no trace files found under {inputs}")
+    return paths
+
+
+def check_alignment(metas: List[Dict], max_unc_us: float,
+                    force: bool) -> List[str]:
+    """Validate that every rank can be laid on ONE reference clock within
+    ``max_unc_us``.  Returns the list of alignment problems (empty when
+    faithfully aligned); raises `MergeError` on refusal.  With ``force``
+    the problems come back as warnings and the caller marks the merge
+    unaligned."""
+    multi = len(metas) > 1
+    refs = {m["clock"]["ref"] for m in metas if m["clock"]}
+    problems: List[str] = []
+    if multi and len(refs) > 1:
+        problems.append(f"traces are aligned against DIFFERENT reference "
+                        f"clocks {sorted(refs)}: offsets are not "
+                        f"comparable")
+    for m in metas:
+        if m["clock"] is None:
+            if multi:
+                problems.append(
+                    f"rank {m['rank']} ({m['path']}) carries no clock-"
+                    f"alignment block (otherData.clock): was the run "
+                    f"elastic? single-rank traces merge without one")
+            continue
+        unc_us = m["clock"]["uncertainty_ns"] / 1e3
+        if unc_us > max_unc_us:
+            problems.append(
+                f"rank {m['rank']}: offset uncertainty {unc_us:.1f}us "
+                f"exceeds the merge resolution {max_unc_us:.1f}us — "
+                f"cross-rank ordering at that scale would be noise")
+    if problems and not force:
+        raise MergeError("refusing to merge:\n  " + "\n  ".join(problems)
+                         + "\n(re-run with --force for an unaligned "
+                           "best-effort merge, or raise "
+                           "--max-uncertainty-us)")
+    return problems
+
+
+def merge(paths: List[str], *, max_uncertainty_us: float = 5000.0,
+          force: bool = False,
+          run_id: Optional[str] = None) -> Tuple[Dict, List[str]]:
+    """Merge ``paths`` into one aligned trace doc; returns
+    ``(merged_doc, warnings)``.  ``run_id`` selects one run out of a
+    trace dir shared by several (the run-id-namespaced exports)."""
+    metas: List[Dict] = []
+    for p in paths:
+        doc = load_trace(p)
+        other = doc.get("otherData", {})
+        metas.append({
+            "path": p, "rank": rank_of(doc, p), "doc": doc,
+            "clock": other.get("clock") or None,
+            "run_id": other.get("run_id"),
+            "dropped": int(other.get("dropped_events", 0) or 0),
+        })
+    if run_id is not None:
+        metas = [m for m in metas if m["run_id"] == run_id]
+        if not metas:
+            raise MergeError(f"no trace carries run id {run_id!r}")
+    seen_ranks: Dict[int, str] = {}
+    for m in metas:
+        if m["rank"] in seen_ranks:
+            prev = seen_ranks[m["rank"]]
+            rids = sorted({x["run_id"] for x in metas
+                           if x["run_id"] is not None})
+            hint = (f"; the directory holds several runs ({rids}) — "
+                    f"select one with --run-id" if len(rids) > 1 else "")
+            raise MergeError(f"rank {m['rank']} appears twice ({prev} and "
+                             f"{m['path']}): merge inputs must be one "
+                             f"trace per rank{hint}")
+        seen_ranks[m["rank"]] = m["path"]
+    metas.sort(key=lambda m: m["rank"])
+    align_problems = check_alignment(metas, max_uncertainty_us, force)
+    warnings = list(align_problems)
+
+    run_ids = {m["run_id"] for m in metas if m["run_id"]}
+    if len(run_ids) > 1:
+        warnings.append(f"traces carry different run ids {sorted(run_ids)}"
+                        f" — merging anyway, but these may be different "
+                        f"runs")
+    for m in metas:
+        if m["dropped"] > 0:
+            warnings.append(
+                f"rank {m['rank']} DROPPED {m['dropped']} events "
+                f"(CYLON_TPU_TRACE_BUFFER_CAP too small): skew and "
+                f"self-time numbers from a truncated buffer are "
+                f"misleading")
+
+    events: List[Dict] = []
+    per_rank: Dict[str, Dict] = {}
+    for m in metas:
+        offset_us = (m["clock"]["offset_ns"] / 1e3) if m["clock"] else 0.0
+        unc_us = (m["clock"]["uncertainty_ns"] / 1e3) if m["clock"] else None
+        per_rank[str(m["rank"])] = {
+            "path": os.path.basename(m["path"]), "offset_us": offset_us,
+            "uncertainty_us": unc_us, "dropped_events": m["dropped"],
+            "events": len(m["doc"]["traceEvents"]),
+        }
+        # metadata events carry ts=0 so strict schema validators
+        # (load_trace requires name/ph/ts/pid/tid) accept the merge
+        events.append({"name": "process_name", "ph": "M", "ts": 0.0,
+                       "pid": m["rank"], "tid": 0,
+                       "args": {"name": f"rank {m['rank']}"}})
+        events.append({"name": "process_sort_index", "ph": "M", "ts": 0.0,
+                       "pid": m["rank"], "tid": 0,
+                       "args": {"sort_index": m["rank"]}})
+        for e in m["doc"]["traceEvents"]:
+            out = dict(e)
+            out["ts"] = e["ts"] + offset_us
+            out["pid"] = m["rank"]
+            events.append(out)
+    # one timeline, ordered on the aligned clock (metadata events first)
+    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
+    merged = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "cylon_tpu.tools.trace_merge",
+            "ranks": sorted(seen_ranks),
+            "run_id": next(iter(run_ids)) if len(run_ids) == 1 else None,
+            # a --force merge whose alignment checks FAILED is marked
+            # unaligned: consumers asserting on this flag must not
+            # accept a timeline whose cross-rank ordering is noise
+            "aligned": not align_problems
+                       and (len(metas) == 1
+                            or all(m["clock"] is not None for m in metas)),
+            "max_uncertainty_us": max((per_rank[r]["uncertainty_us"] or 0.0)
+                                      for r in per_rank),
+            "per_rank": per_rank,
+            "dropped_events": sum(m["dropped"] for m in metas),
+            "warnings": warnings,
+        },
+    }
+    return merged, warnings
+
+
+def collective_skew(events: List[Dict]) -> List[Dict]:
+    """Per-collective skew rows from merged ``collective.arrive`` /
+    ``collective.depart`` instants, grouped by (collective, epoch, seq).
+    ``skew_us`` is last-arrival minus first-arrival on the aligned
+    clock; ``wait_us[rank]`` is how long each rank stalled for the
+    slowest (its arrival lead over the last one)."""
+    groups: Dict[Tuple, Dict] = {}
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") not in (
+                "collective.arrive", "collective.depart"):
+            continue
+        a = e.get("args", {})
+        key = (str(a.get("collective", "?")), a.get("epoch"), a.get("seq"))
+        g = groups.setdefault(key, {"arrive": {}, "depart": {}})
+        rank = a.get("rank", e.get("pid"))
+        side = "arrive" if e["name"].endswith("arrive") else "depart"
+        cur = g[side].get(rank)
+        if cur is None or e["ts"] < cur:
+            g[side][rank] = e["ts"]
+    rows: List[Dict] = []
+    for (name, epoch, seq), g in sorted(
+            groups.items(),
+            key=lambda kv: (min(kv[1]["arrive"].values())
+                            if kv[1]["arrive"] else 0.0)):
+        arr = g["arrive"]
+        if not arr:
+            continue
+        last_rank = max(arr, key=lambda r: arr[r])
+        first_ts, last_ts = min(arr.values()), arr[last_rank]
+        rows.append({
+            "collective": name, "epoch": epoch, "seq": seq,
+            "ranks": sorted(arr),
+            "skew_us": round(last_ts - first_ts, 3),
+            "slowest_rank": last_rank,
+            "wait_us": {str(r): round(last_ts - t, 3)
+                        for r, t in sorted(arr.items())},
+            "departed": sorted(g["depart"]),
+        })
+    return rows
+
+
+def validate_merged(doc: Dict) -> None:
+    """Schema + monotonicity: every event well-formed, the non-metadata
+    stream sorted ascending on the aligned clock."""
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    for ev in evs:
+        for k in ("name", "ph", "ts", "pid", "tid"):
+            if k not in ev:
+                raise ValueError(f"merged event missing {k!r}: {ev}")
+        if ev["ph"] == "X" and "dur" not in ev:
+            raise ValueError(f"merged complete event missing dur: {ev}")
+    ts = [e["ts"] for e in evs]
+    if any(b < a for a, b in zip(ts, ts[1:])):
+        raise ValueError("merged timeline is not monotone in ts")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_merge",
+        description="merge per-rank cylon_tpu.obs traces onto one "
+                    "aligned clock + per-collective skew attribution")
+    ap.add_argument("inputs", nargs="+",
+                    help="per-rank trace JSONs, or a directory of them")
+    ap.add_argument("-o", "--out", default=None,
+                    help="merged trace path (default: merged.trace.json "
+                         "beside the first input)")
+    ap.add_argument("--max-uncertainty-us", type=float, default=5000.0,
+                    help="refuse to merge when any rank's clock-offset "
+                         "uncertainty exceeds this (default 5000)")
+    ap.add_argument("--force", action="store_true",
+                    help="merge anyway (unaligned/uncertain clocks); the "
+                         "output is marked aligned=false")
+    ap.add_argument("--run-id", default=None,
+                    help="merge only traces carrying this otherData."
+                         "run_id (a trace dir shared by several runs)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary on stdout")
+    args = ap.parse_args(argv)
+    try:
+        paths = discover(args.inputs)
+        merged, warnings = merge(paths,
+                                 max_uncertainty_us=args.max_uncertainty_us,
+                                 force=args.force, run_id=args.run_id)
+    except (MergeError, ValueError) as e:
+        # ValueError: an input failed schema validation (not a trace at
+        # all) — a clean refusal, not a traceback
+        print(f"trace_merge: {e}", file=sys.stderr)
+        return 2
+    validate_merged(merged)
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(paths[0])), "merged.trace.json")
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(merged, fh)
+    for w in warnings:
+        print(f"trace_merge: WARNING: {w}", file=sys.stderr)
+    skew = collective_skew(merged["traceEvents"])
+    if args.json:
+        json.dump({"out": out,
+                   "ranks": merged["otherData"]["ranks"],
+                   "events": len(merged["traceEvents"]),
+                   "dropped_events": merged["otherData"]["dropped_events"],
+                   "aligned": merged["otherData"]["aligned"],
+                   "per_rank": merged["otherData"]["per_rank"],
+                   "warnings": warnings,
+                   "collectives": skew}, sys.stdout, indent=1,
+                  sort_keys=True)
+        print()
+        return 0
+    od = merged["otherData"]
+    print(f"merged {len(paths)} trace(s) -> {out}  ranks={od['ranks']}  "
+          f"events={len(merged['traceEvents'])}  "
+          f"max_unc={od['max_uncertainty_us']:.1f}us")
+    if skew:
+        print("\nper-collective skew (slowest-rank attribution):")
+        print(f"  {'collective':40s} {'epoch':>5s} {'ranks':>7s} "
+              f"{'skew ms':>9s}  slowest")
+        for r in skew:
+            print(f"  {r['collective'][:40]:40s} {str(r['epoch']):>5s} "
+                  f"{len(r['ranks']):>7d} {r['skew_us'] / 1e3:9.3f}  "
+                  f"r{r['slowest_rank']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

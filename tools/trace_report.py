@@ -1,24 +1,39 @@
 """Summarize a cylon_tpu.obs trace export: top-K self-time + collectives.
 
-Loads a Chrome-trace JSON written by ``cylon_tpu.obs.export`` and prints
+Loads a Chrome-trace JSON written by ``cylon_tpu.obs.export`` (or merged
+by ``tools/trace_merge.py``) and prints
 
 - a top-K table by SELF time (a span's duration minus its children's, so
   a fat parent that merely wraps a fat child doesn't dominate the table),
 - the instant-event tally (retries, injected faults, OOM refinements),
+- per-collective skew rows when the trace carries cross-rank
+  ``collective.arrive`` instants (a merged elastic trace),
+- per-tenant SLO latency rows (queue-wait vs run split) from the serve
+  histograms in the metrics artifact,
 - when the sibling metrics artifact exists (``<name>.metrics.rN.json``
   next to the trace, or passed explicitly), the collective/bytes summary
   — launches, exchanges, bytes sent, plan-cache traffic.
 
+A trace whose buffer DROPPED events gets a loud stderr warning: totals
+and skew from a truncated buffer are misleading, and silently reporting
+them would launder bad numbers into good-looking tables.
+
+``--json`` emits the whole report as one machine-readable object
+(totals, skew table, SLO rows) so CI and the battery can assert on
+content instead of grepping human text.
+
 Usage:
     python tools/trace_report.py TRACE.json [METRICS.json] [--top K]
+                                 [--json]
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import sys
 from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 def load_trace(path: str) -> Dict[str, object]:
@@ -93,6 +108,122 @@ def tenant_attribution(events: List[dict]) -> Dict[str, Tuple[int, float]]:
     return out
 
 
+_merge_tool_cache = None
+
+
+def _merge_tool():
+    """The sibling trace_merge.py, loaded by file path — ONE
+    implementation of the skew-attribution math for both tools, without
+    either gaining a package import (both stay pure stdlib)."""
+    global _merge_tool_cache
+    if _merge_tool_cache is None:
+        import importlib.util
+
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "trace_merge.py")
+        spec = importlib.util.spec_from_file_location("_trace_merge", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _merge_tool_cache = mod
+    return _merge_tool_cache
+
+
+def collective_skew(events: List[dict]) -> List[dict]:
+    """Per-collective skew rows from ``collective.arrive`` /
+    ``collective.depart`` instants grouped by (collective, epoch, seq) —
+    meaningful on a MERGED trace where the instants come from several
+    ranks on one aligned clock.  Delegates to trace_merge.py so the
+    attribution math has exactly one implementation."""
+    return _merge_tool().collective_skew(events)
+
+
+def slo_rows(metrics_doc: dict) -> Dict[str, dict]:
+    """Per-tenant SLO latency rows from the serve histograms
+    (``serve.queue_wait_ms[<tenant>]`` / ``serve.run_ms[<tenant>]``)."""
+    out: Dict[str, dict] = {}
+    for key, h in (metrics_doc.get("histograms") or {}).items():
+        if not key.startswith("serve.") or "[" not in key:
+            continue
+        kind, tenant = key[len("serve."):].split("[", 1)
+        n = int(h.get("count", 0))
+        out.setdefault(tenant.rstrip("]"), {})[kind] = {
+            "count": n,
+            "mean_ms": (float(h.get("sum", 0.0)) / n) if n else None,
+            "min_ms": h.get("min"), "max_ms": h.get("max")}
+    return out
+
+
+def _dropped_warning(where: str, dropped: int) -> None:
+    if dropped > 0:
+        print(f"trace_report: WARNING: {where} DROPPED {dropped} events "
+              f"(CYLON_TPU_TRACE_BUFFER_CAP too small) — self-time and "
+              f"skew numbers from a truncated buffer are misleading",
+              file=sys.stderr)
+
+
+def report_dict(trace_path: str, metrics_path: Optional[str],
+                top: int) -> dict:
+    """The whole report as one machine-readable object (``--json``)."""
+    doc = load_trace(trace_path)
+    events = doc["traceEvents"]
+    other = doc.get("otherData", {})
+    st = self_times(events)
+    instants: Dict[str, int] = defaultdict(int)
+    for e in events:
+        if e.get("ph") == "i":
+            instants[e["name"]] += 1
+    metrics_path = _sibling_metrics(trace_path, metrics_path)
+    m = load_metrics(metrics_path) if metrics_path else {}
+    return {
+        "trace": trace_path,
+        "rank": other.get("rank"),
+        "run_id": other.get("run_id"),
+        "events": len(events),
+        "dropped_events": int(other.get("dropped_events", 0) or 0),
+        "totals": {
+            "spans": sum(n for n, _, _ in st.values()),
+            "self_ms": round(sum(s for _, _, s in st.values()) / 1e3, 6),
+        },
+        "self_times": [
+            {"span": name, "count": n, "total_ms": round(tot / 1e3, 6),
+             "self_ms": round(self_us / 1e3, 6)}
+            for name, (n, tot, self_us)
+            in sorted(st.items(), key=lambda kv: -kv[1][2])[:top]],
+        "instants": dict(sorted(instants.items())),
+        "tenants": {t: {"requests": n, "total_ms": round(us / 1e3, 6)}
+                    for t, (n, us)
+                    in sorted(tenant_attribution(events).items())},
+        "skew": collective_skew(events),
+        "slo": slo_rows(m),
+        "metrics": metrics_path,
+        "counters": m.get("counters", {}),
+    }
+
+
+def _sibling_metrics(trace_path: str,
+                     metrics_path: Optional[str]) -> Optional[str]:
+    """Resolve the metrics artifact beside a trace (explicit path wins)."""
+    if metrics_path is not None:
+        return metrics_path if os.path.exists(metrics_path) else None
+    import re
+
+    d, base = os.path.split(trace_path)
+    head, _, rest = base.partition(".")
+    cands = [
+        # export_all naming: prefix.rN.json -> prefix.metrics.rN.json
+        os.path.join(d, re.sub(r"\.r(\d+)\.json$", r".metrics.r\1.json",
+                               base)),
+        # run-id naming: prefix.<run>.rN.json -> prefix.metrics.<run>.rN.json
+        os.path.join(d, f"{head}.metrics.{rest}") if rest else "",
+        # plain export naming: trace.rN.json -> metrics.rN.json
+        os.path.join(d, base.replace("trace", "metrics", 1)),
+    ]
+    for cand in cands:
+        if cand and cand != trace_path and os.path.exists(cand):
+            return cand
+    return None
+
+
 def print_report(trace_path: str, metrics_path: "str | None",
                  top: int) -> None:
     doc = load_trace(trace_path)
@@ -100,8 +231,10 @@ def print_report(trace_path: str, metrics_path: "str | None",
     other = doc.get("otherData", {})
     st = self_times(events)
     grand_self = sum(s for _, _, s in st.values()) or 1.0
+    dropped = int(other.get("dropped_events", 0) or 0)
+    _dropped_warning(trace_path, dropped)
     print(f"trace: {trace_path}  rank={other.get('rank', '?')}  "
-          f"events={len(events)}  dropped={other.get('dropped_events', 0)}")
+          f"events={len(events)}  dropped={dropped}")
     print(f"\ntop {top} by self time:")
     print(f"{'span':34s} {'count':>7s} {'total ms':>10s} {'self ms':>10s} "
           f"{'self %':>7s}")
@@ -127,24 +260,33 @@ def print_report(trace_path: str, metrics_path: "str | None",
             n, us = tenants[t]
             print(f"  {t:24s} {n:8d} {us / 1e3:10.3f}")
 
-    if metrics_path is None:
-        import re
+    skew = collective_skew(events)
+    if skew:
+        print("\nper-collective skew (slowest-rank attribution; "
+              "meaningful on a merged, clock-aligned trace):")
+        print(f"  {'collective':40s} {'epoch':>5s} {'ranks':>5s} "
+              f"{'skew ms':>9s}  slowest")
+        for r in skew:
+            print(f"  {r['collective'][:40]:40s} {str(r['epoch']):>5s} "
+                  f"{len(r['ranks']):>5d} {r['skew_us'] / 1e3:9.3f}  "
+                  f"r{r['slowest_rank']}")
 
-        d, base = os.path.split(trace_path)
-        cands = [
-            # export_all naming: prefix.rN.json -> prefix.metrics.rN.json
-            os.path.join(d, re.sub(r"\.r(\d+)\.json$", r".metrics.r\1.json",
-                                   base)),
-            # plain export naming: trace.rN.json -> metrics.rN.json
-            os.path.join(d, base.replace("trace", "metrics", 1)),
-        ]
-        for cand in cands:
-            if cand != trace_path and os.path.exists(cand):
-                metrics_path = cand
-                break
+    metrics_path = _sibling_metrics(trace_path, metrics_path)
     if metrics_path and os.path.exists(metrics_path):
         m = load_metrics(metrics_path)
         c = m.get("counters", {})
+        slo = slo_rows(m)
+        if slo:
+            print("\nper-tenant SLO latency (queue-wait vs run):")
+            print(f"  {'tenant':20s} {'phase':>12s} {'count':>6s} "
+                  f"{'mean ms':>9s} {'max ms':>9s}")
+            for t, row in sorted(slo.items()):
+                for kind in ("queue_wait_ms", "run_ms"):
+                    h = row.get(kind)
+                    if not h or not h["count"]:
+                        continue
+                    print(f"  {t:20s} {kind[:-3]:>12s} {h['count']:6d} "
+                          f"{h['mean_ms']:9.2f} {h['max_ms']:9.2f}")
         print(f"\nmetrics: {metrics_path}")
         print(f"  shuffle exchanges          {c.get('shuffle.exchanges', 0):>12}")
         print(f"  collective launches        "
@@ -210,7 +352,16 @@ def main(argv=None) -> int:
     ap.add_argument("metrics", nargs="?", default=None,
                     help="metrics JSON (default: sibling of the trace)")
     ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout (totals, "
+                         "skew table, per-tenant SLO rows)")
     args = ap.parse_args(argv)
+    if args.json:
+        rep = report_dict(args.trace, args.metrics, args.top)
+        _dropped_warning(args.trace, rep["dropped_events"])
+        json.dump(rep, sys.stdout, indent=1, sort_keys=True)
+        print()
+        return 0
     print_report(args.trace, args.metrics, args.top)
     return 0
 
