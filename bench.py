@@ -560,6 +560,12 @@ class _Bench:
         }
         if r.get("stale_code"):
             out["stale_code"] = True
+        if source == "cache":
+            # replayed fragment, loud and machine-readable: BENCH_r03–r05
+            # all re-served the same cached 5.31M rows/s entry with only
+            # `source` distinguishing them — future rounds (and their
+            # judges) key off this flag instead of a string compare
+            out["cache_served"] = True
         if r.get("trace_artifact"):
             out["trace_artifact"] = r["trace_artifact"]
         if r.get("passes"):

@@ -17,6 +17,7 @@ Layer map (mirrors SURVEY.md §1):
   L4 dist ops  — parallel/ops.py
   L5 table API — table.py, column.py
   L6 bindings  — frame.py (DataFrame), this package (PyCylon role)
+  L7 planner   — plan/ (logical IR, rule optimizer, fused executor)
 """
 
 import jax as _jax

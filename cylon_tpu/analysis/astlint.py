@@ -39,6 +39,8 @@ RULES: Dict[str, str] = {
              "an epoch guard",
     "CY107": "blocking device call reachable from the serve "
              "admission/scheduler control path",
+    "CY108": "plan optimizer/executor reads a trace-scope knob the plan "
+             "fingerprint does not cover",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
 }
@@ -75,6 +77,19 @@ SERVE_CONTROL_PREFIXES = ("_dispatch", "_admit", "_shed", "_cancel")
 #: work, for CY107 reachability
 BLOCKING_DEVICE_NAMES = frozenset({
     "block_until_ready", "device_get", "device_put", "to_numpy"})
+
+#: the planner package and its rule/executor roots, for CY108: the plan
+#: FINGERPRINT is the durable/serve result-cache key for whole planned
+#: runs — if an optimizer rule or executor path reads a trace-scope knob
+#: (the traced computation, hence the result, can change with it), the
+#: fingerprint must cover every trace knob (trace_cache_token) or a knob
+#: flip would serve a stale cached result (the CY103 bug class, lifted
+#: from jit-plan caches to the new plan cache)
+PLAN_MODULE_PREFIX = "cylon_tpu.plan"
+PLAN_ROOT_NAMES = frozenset({"optimize", "execute", "run_service"})
+PLAN_ROOT_PREFIXES = ("_rule_", "_lower", "_stage", "_exec", "_fused",
+                      "plane_annotation")
+PLAN_FP_TOKEN = "fingerprint"
 
 _SUPPRESS_RE = re.compile(
     r"#\s*cylint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--\s*(\S.*))?\s*$")
@@ -850,6 +865,61 @@ def _check_serve_blocking(prog: _Program, mod: _Module) -> None:
                 "admission/dispatch decisions must be host-only"))
 
 
+def _check_plan_fingerprint(prog: _Program, mod: _Module) -> None:
+    """CY108: a plan-optimizer rule or executor path (module under
+    ``cylon_tpu.plan``; roots ``optimize``/``execute``/``run_service``
+    or ``_rule_*``/``_exec*``/``_fused*``/``_lower*``/``_stage*``)
+    from which a TRACE-scope knob read is reachable, while no plan
+    fingerprint builder (a ``*fingerprint*`` function under the plan
+    package) reaches ``trace_cache_token``.
+
+    The invariant: the plan fingerprint is the durable-journal / serve
+    result-cache key for WHOLE planned runs.  A trace knob changes the
+    traced computation, hence the cached result — if the executor can
+    see the knob but the fingerprint cannot, flipping it replays a
+    stale result from spill.  The fix is structural (cover all trace
+    knobs via config.trace_cache_token() in the fingerprint), so the
+    check is package-level: one complete fingerprint builder clears
+    every root."""
+    if not mod.name.startswith(PLAN_MODULE_PREFIX):
+        return
+    roots = [f for f in mod.funcs.values()
+             if f.qual.rsplit(".", 1)[-1] in PLAN_ROOT_NAMES
+             or f.qual.rsplit(".", 1)[-1].startswith(PLAN_ROOT_PREFIXES)]
+    hot = []
+    for f in roots:
+        knobs = {k for k in prog.knobs_of(f) if k in _TRACE_KNOBS}
+        if knobs:
+            hot.append((f, knobs))
+    if not hot:
+        return
+    complete = False
+    for f in prog.by_qual.values():
+        if not f.module.startswith(PLAN_MODULE_PREFIX):
+            continue
+        if PLAN_FP_TOKEN not in f.qual.rsplit(".", 1)[-1]:
+            continue
+        for q in prog.reachable(f):
+            fn = prog.by_qual.get(q)
+            if fn is not None and "trace_cache_token" in fn.call_finals:
+                complete = True
+                break
+        if complete:
+            break
+    if complete:
+        return
+    for f, knobs in hot:
+        mod.findings.append(Finding(
+            "CY108", mod.path, f.lineno,
+            f"plan path `{f.qual.rsplit('.', 1)[-1]}` reads trace-scope "
+            f"knob(s) {', '.join(sorted(knobs))} but no plan fingerprint "
+            f"builder covers the trace-knob vector — flipping the knob "
+            f"would replay a stale cached plan result",
+            "hash config.trace_cache_token() into the plan fingerprint "
+            "(durable.run_fingerprint already does) or stop reading the "
+            "knob on the optimizer/executor path"))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -885,6 +955,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_plan_keys(prog, mod)
         _check_elastic_guards(prog, mod)
         _check_serve_blocking(prog, mod)
+        _check_plan_fingerprint(prog, mod)
         for f in mod.funcs.values():
             if f.qual in traced:
                 _Taint(f, mod, mod.findings).run()

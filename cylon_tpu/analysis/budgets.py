@@ -283,12 +283,91 @@ def _trace_chunked_pass(ctx) -> Dict[str, Dict]:
     return {"pass": collect_counts(jaxpr)}
 
 
+class _LaunchMeter:
+    """Counts ENFORCED collective launches across EVERY program
+    *invocation* of a run (not just unique programs): the per-PLAN
+    budget must see that an eager self-join runs the same cached
+    shuffle program twice.  Wraps ``parallel.ops._shard_map`` — the
+    only builder whose programs carry collectives; shard-wise local
+    programs are collective-free by construction (the chunked_pass
+    golden pins that)."""
+
+    def __init__(self):
+        self.totals: Dict[str, int] = {p: 0 for p in ENFORCED_PRIMS}
+        self._per_entry: Dict[int, Dict[str, int]] = {}
+
+    def __enter__(self):
+        import jax
+
+        from ..parallel import ops as par_ops
+
+        self._par_ops = par_ops
+        self._orig = par_ops._shard_map
+        meter = self
+
+        def instrumented(ctx, fn, key, shapes_key, out_specs=None):
+            entry = meter._orig(ctx, fn, key, shapes_key, out_specs)
+
+            def counting(*args):
+                counts = meter._per_entry.get(id(entry))
+                if counts is None:
+                    jaxpr = jax.make_jaxpr(entry)(*args)
+                    counts = {p: count_prims(jaxpr.jaxpr, (p,))
+                              for p in ENFORCED_PRIMS}
+                    meter._per_entry[id(entry)] = counts
+                for p, n in counts.items():
+                    meter.totals[p] += n
+                return entry(*args)
+
+            return counting
+
+        par_ops._shard_map = instrumented
+        return self
+
+    def __exit__(self, *exc):
+        self._par_ops._shard_map = self._orig
+        return False
+
+
+def _plan_join_groupby_query(ctx):
+    """The canonical join→groupby-on-same-key plan: a SELF-join (both
+    sides scan the same table) grouped on the join key — the shape
+    ROADMAP item 1 names, where the planner's scan sharing + shuffle
+    elision collapse 3 eager exchanges (left, right, partials) into
+    exactly ONE packed exchange."""
+    t = _canonical_table(ctx)
+    left = t.plan().project(["k32", "f64"])
+    right = t.plan().project(["k32"])
+    return (left.join(right, on="k32", how="inner")
+            .groupby(["l_k32"], {"f64": ["sum"]}))
+
+
+def _trace_plan_join_groupby(ctx) -> Dict[str, Dict]:
+    """Per-PLAN collective budget: total enforced launches across every
+    program invocation of the whole plan run, planner on vs off.  The
+    committed golden pins planner=1 all_to_all vs eager=3 — a future
+    optimizer edit that silently stops eliding (or an executor edit
+    that re-shuffles) regresses this by integer amounts."""
+    out: Dict[str, Dict] = {}
+    for label, mode in (("planner", "1"), ("eager", "0")):
+        with config.knob_env(CYLON_TPU_PLAN=mode,
+                             CYLON_TPU_SHUFFLE="bucketed",
+                             CYLON_TPU_SHUFFLE_PACK="1"):
+            q = _plan_join_groupby_query(ctx)
+            with _LaunchMeter() as meter:
+                q.execute()
+            out[label] = {"collectives": dict(meter.totals),
+                          "informational": {}}
+    return out
+
+
 ENTRIES = {
     "shuffle_bucketed": _trace_shuffle_bucketed,
     "task_shuffle": _trace_task_shuffle,
     "hash_partition": _trace_hash_partition,
     "shuffle_ragged": _trace_shuffle_ragged,
     "chunked_pass": _trace_chunked_pass,
+    "plan_join_groupby": _trace_plan_join_groupby,
 }
 
 

@@ -208,6 +208,16 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "all_to_all; auto probes the backend.  Selected at plan-build "
             "time on the host (the two families build differently-keyed "
             "plans, so no cache-key participation is needed)."),
+    _K("CYLON_TPU_PLAN", "enum", "auto", RUNTIME,
+       choices=("1", "on", "0", "off", "auto"),
+       accessors=("cylon_tpu.plan.executor.planner_enabled",),
+       help="Logical-plan optimizer for Table.plan() pipelines: shuffle "
+            "elision, column pruning, scan sharing and fused local "
+            "kernels (auto/on, default) vs eager per-op lowering (off — "
+            "the A/B baseline).  A host-side plan-build choice like "
+            "CYLON_TPU_SHUFFLE: each mode builds differently-keyed stage "
+            "programs, so no cache-key participation; results are "
+            "bit-identical either way."),
     _K("CYLON_TPU_MAX_STRING_WIDTH", "int", 4096, RUNTIME,
        help="Widest byte matrix a string column may ingest without an "
             "explicit string_width= (HBM guard)."),

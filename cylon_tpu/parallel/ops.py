@@ -25,6 +25,7 @@ from ..ops import aggregates as agg_mod
 from ..ops import groupby as groupby_mod
 from ..ops import sort as sort_mod
 from ..ops.groupby import AggOp
+from ..status import Code, CylonError
 from . import collectives
 from . import partition as partition_mod
 from . import plane as plane_mod
@@ -295,8 +296,18 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
 
 
 def shuffle(t, key_idx: Tuple[int, ...]):
-    """Hash-repartition rows so equal keys land on the same shard."""
-    return _shuffled(t, tuple(key_idx), "hash")
+    """Hash-repartition rows so equal keys land on the same shard.
+
+    The result is stamped with its partitioning property
+    (``_partitioning = ("hash", ((key names,),), world)``) — the
+    planner (cylon_tpu.plan) treats partitioning as tracked data
+    state, so a downstream join/group-by on compatible keys can elide
+    its own exchange entirely."""
+    key_idx = tuple(key_idx)
+    out = _shuffled(t, key_idx, "hash")
+    out._partitioning = ("hash", (tuple(t.names[i] for i in key_idx),),
+                         t.num_shards)
+    return out
 
 
 def hash_partition(t, key_idx: Tuple[int, ...], num_partitions: int):
@@ -399,9 +410,71 @@ def distributed_sort(t, by_idx: Tuple[int, ...], opts: SortOptions,
 # groupby/groupby.cpp:23-73 — partial agg, shuffle, final agg)
 # ---------------------------------------------------------------------------
 
+def groupby_partial_plan(aggs):
+    """Expand requested aggs into the deduped partial-op list and its
+    index: ``(partial_list, partial_index)`` where ``partial_list`` is
+    ``[(src_col, partial_op), ...]`` and ``partial_index[(src, pop)]``
+    is that partial's position.  ``aggs`` entries may name columns by
+    index or by name — the caller's namespace is preserved.  Shared by
+    the distributed two-phase group-by and the planner's fused
+    join→aggregate shard body (plan/executor.py), so the two can never
+    disagree on the partial layout."""
+    partial_list: list = []
+    partial_index: Dict[tuple, int] = {}
+    for ci, op in aggs:
+        for pop in groupby_mod.partial_ops(op):
+            k = (ci, pop)
+            if k not in partial_index:
+                partial_index[k] = len(partial_list)
+                partial_list.append(k)
+    return partial_list, partial_index
+
+
+def finalize_groupby_columns(fcols, nkeys: int, aggs, partial_index,
+                             ddof: int):
+    """Combine-phase outputs -> the requested agg columns: pass-through
+    for SUM/MIN/MAX/COUNT, derived math for MEAN/VAR/STDDEV.  Pure jnp
+    on the combined columns, so it runs identically on host-side global
+    arrays (distributed_groupby step 5) and INSIDE a traced shard body
+    (the planner's fused local aggregate) — bit-identity between the
+    eager and fused paths rests on this being single-sourced."""
+    out_cols = list(fcols[:nkeys])
+    for ci, op in aggs:
+        def pcol(pop, _ci=ci):
+            return fcols[nkeys + partial_index[(_ci, pop)]]
+
+        facc = precision.float_acc()
+        fdt = dtypes.float_ if precision.narrow() else dtypes.double
+        if op in (AggOp.SUM, AggOp.MIN, AggOp.MAX, AggOp.COUNT,
+                  AggOp.SUMSQ, AggOp.COUNTSUM):
+            out_cols.append(pcol(op))
+        elif op == AggOp.MEAN:
+            s, c = pcol(AggOp.SUM), pcol(AggOp.COUNT)
+            cnt = jnp.maximum(c.data, 1).astype(facc)
+            v = s.data.astype(facc) / cnt
+            valid = s.validity & (c.data > 0)
+            out_cols.append(Column(jnp.where(valid, v, 0.0), valid, None,
+                                   fdt))
+        elif op in (AggOp.VAR, AggOp.STDDEV):
+            s, c, s2 = pcol(AggOp.SUM), pcol(AggOp.COUNT), pcol(AggOp.SUMSQ)
+            n = jnp.maximum(c.data, 1).astype(facc)
+            var = (s2.data - s.data.astype(facc) ** 2 / n) / jnp.maximum(
+                n - ddof, 1.0)
+            var = jnp.maximum(var, 0.0)
+            if op == AggOp.STDDEV:
+                var = jnp.sqrt(var)
+            valid = s.validity & ((c.data - ddof) > 0)
+            out_cols.append(Column(jnp.where(valid, var, 0.0), valid, None,
+                                   fdt))
+        else:
+            raise NotImplementedError(op)
+    return out_cols
+
+
 def distributed_groupby(t, by_idx: Tuple[int, ...],
                         aggs: Tuple[Tuple[int, AggOp], ...], ddof: int,
-                        pipeline: bool = False):
+                        pipeline: bool = False,
+                        pre_partitioned: bool = False):
     """Two-phase distributed group-by.
 
     ``pipeline=False`` — the reference's DistributedHashGroupBy
@@ -412,12 +485,23 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
     key-sorted rows; after the shuffle each shard sorts its received
     partials before the final pipeline pass (the reference's local Sort at
     groupby.cpp:103-107).
+
+    ``pre_partitioned=True`` — the planner's shuffle elision: the caller
+    proves the input is already hash-partitioned on a subset of the
+    group keys (every group fully on one shard), so the partial shuffle
+    is SKIPPED and the final combine folds each group's single partial
+    locally — bit-identical to the shuffled path, because combining one
+    partial is the identity for every combine op.
     """
     from ..table import Table, _groupby_output_names, _local_groupby, _shard_wise
 
     names_out = _groupby_output_names(t, by_idx, aggs)
     ctx = t.ctx
 
+    if pre_partitioned and any(op == AggOp.NUNIQUE for _, op in aggs):
+        raise CylonError(Code.Invalid,
+                         "pre_partitioned group-by cannot carry NUNIQUE "
+                         "(no partial/combine decomposition)")
     if any(op == AggOp.NUNIQUE for _, op in aggs):
         # NUNIQUE does not decompose into partial+combine columns; instead
         # co-locate raw rows by key (shuffle) and run ONE local group-by —
@@ -449,14 +533,7 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
         return out.rename(names_out)
 
     # 1. expand requested aggs into partial ops, dedup
-    partial_list: list = []          # (src_col_idx, partial_op)
-    partial_index: Dict[tuple, int] = {}
-    for ci, op in aggs:
-        for pop in groupby_mod.partial_ops(op):
-            k = (ci, pop)
-            if k not in partial_index:
-                partial_index[k] = len(partial_list)
-                partial_list.append(k)
+    partial_list, partial_index = groupby_partial_plan(aggs)
 
     nkeys = len(by_idx)
 
@@ -476,8 +553,11 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
                           ddof, pipeline),
                          _shapes_key(t))(t)
 
-    # 3. shuffle partials on the key columns
-    shuffled = shuffle(partial, tuple(range(nkeys)))
+    # 3. shuffle partials on the key columns — unless the caller proved
+    # the input pre-partitioned (every group's rows, hence its single
+    # partial, already live on one shard)
+    shuffled = partial if pre_partitioned else shuffle(
+        partial, tuple(range(nkeys)))
 
     # 4. final combine: SUM of sums/counts/sumsqs, MIN of mins, MAX of maxes
     final_aggs = tuple((nkeys + i, groupby_mod.combine_op(pop))
@@ -501,37 +581,16 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
         _shapes_key(shuffled))(shuffled)
 
     # 5. finalize derived outputs (MEAN/VAR/STDDEV) from combined partials
-    out_cols = list(fcols[:nkeys])
-    for ci, op in aggs:
-        def pcol(pop):
-            return fcols[nkeys + partial_index[(ci, pop)]]
-
-        facc = precision.float_acc()
-        fdt = dtypes.float_ if precision.narrow() else dtypes.double
-        if op in (AggOp.SUM, AggOp.MIN, AggOp.MAX, AggOp.COUNT,
-                  AggOp.SUMSQ, AggOp.COUNTSUM):
-            out_cols.append(pcol(op))
-        elif op == AggOp.MEAN:
-            s, c = pcol(AggOp.SUM), pcol(AggOp.COUNT)
-            cnt = jnp.maximum(c.data, 1).astype(facc)
-            v = s.data.astype(facc) / cnt
-            valid = s.validity & (c.data > 0)
-            out_cols.append(Column(jnp.where(valid, v, 0.0), valid, None,
-                                   fdt))
-        elif op in (AggOp.VAR, AggOp.STDDEV):
-            s, c, s2 = pcol(AggOp.SUM), pcol(AggOp.COUNT), pcol(AggOp.SUMSQ)
-            n = jnp.maximum(c.data, 1).astype(facc)
-            var = (s2.data - s.data.astype(facc) ** 2 / n) / jnp.maximum(
-                n - ddof, 1.0)
-            var = jnp.maximum(var, 0.0)
-            if op == AggOp.STDDEV:
-                var = jnp.sqrt(var)
-            valid = s.validity & ((c.data - ddof) > 0)
-            out_cols.append(Column(jnp.where(valid, var, 0.0), valid, None,
-                                   fdt))
-        else:
-            raise NotImplementedError(op)
-    return Table(tuple(out_cols), fcounts, names_out, ctx)
+    out_cols = finalize_groupby_columns(fcols, nkeys, aggs, partial_index,
+                                        ddof)
+    out = Table(tuple(out_cols), fcounts, names_out, ctx)
+    if not pre_partitioned:
+        # placed by the partial shuffle's hash of ALL group keys; a
+        # pre-partitioned run is placed by the caller's key SUBSET
+        # instead, which only the planner knows — it stamps its own
+        out._partitioning = ("hash", (tuple(names_out[:nkeys]),),
+                             t.num_shards)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,91 @@
+"""plan.explain(): render the optimized tree without running anything.
+
+Stdlib-only string assembly over the optimizer's annotations: every
+elided shuffle, shared scan, fused stage and pruned column set is
+spelled out, with the packed-plane word width a pruned scan would
+actually exchange (the bytes the pruning rule saves)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import expr as expr_mod
+from . import ir, optimizer
+
+
+def explain(plan, optimized: Optional[bool] = None) -> str:
+    from . import executor
+
+    enabled = executor.planner_enabled() if optimized is None else bool(
+        optimized)
+    phys = optimizer.optimize(plan, enabled=enabled)
+    lines: List[str] = [
+        f"plan [world={phys.world} mode="
+        f"{'optimized' if enabled else 'eager'} nodes={phys.nodes} "
+        f"shuffles_elided={phys.shuffles_elided} "
+        f"columns_pruned={phys.columns_pruned}]"
+    ]
+    _render(plan, phys.root, lines, 1)
+    return "\n".join(lines)
+
+
+def _shuffle_note(ann: tuple) -> str:
+    if not ann or ann[0] == "local":
+        return "local"
+    if ann[0] == "elide":
+        return f"ELIDED (already hash({','.join(ann[1])}))"
+    return f"shuffle({','.join(ann[1])})"
+
+
+def _render(plan, p: optimizer.Phys, lines: List[str], depth: int) -> None:
+    n = p.node
+    pad = "  " * depth
+    if isinstance(n, ir.Scan):
+        t = plan.inputs[n.idx]
+        note = ""
+        if len(p.keep) < len(n.names):
+            ann = optimizer.plane_annotation(t, p.keep)
+            note = (f"  [pruned {len(n.names)}->{len(p.keep)} cols, "
+                    f"plane {ann['words_full']}->{ann['words_pruned']} "
+                    f"words/row]")
+        lines.append(f"{pad}scan {n.label}: {', '.join(p.keep)}{note}")
+        return
+    if isinstance(n, ir.Project):
+        lines.append(f"{pad}project [{', '.join(p.keep)}]")
+    elif isinstance(n, ir.Filter):
+        lines.append(f"{pad}filter {expr_mod.render(n.pred)}")
+    elif isinstance(n, ir.Derive):
+        dead = "  [DEAD: pruned]" if p.ann.get("dead") else ""
+        lines.append(f"{pad}derive {n.name} = "
+                     f"{expr_mod.render(n.value)}{dead}")
+    elif isinstance(n, ir.Join):
+        shared = "  [SHARED SCAN: one exchange feeds both sides]" \
+            if p.ann.get("shared") else ""
+        lines.append(
+            f"{pad}join {n.how}/{n.algorithm} on "
+            f"{','.join(n.left_on)} = {','.join(n.right_on)}  "
+            f"[left: {_shuffle_note(p.ann.get('left', ()))}, "
+            f"right: {_shuffle_note(p.ann.get('right', ()))}]{shared}")
+    elif isinstance(n, ir.Aggregate):
+        mode = p.ann.get("mode", "eager")
+        if mode == "elided":
+            note = (f"  [shuffle ELIDED: hash("
+                    f"{','.join(p.ann.get('part_keys', ()))}) covers the "
+                    f"group keys]")
+        elif mode == "local":
+            note = "  [local]"
+        else:
+            note = f"  [shuffle({','.join(n.by)})]"
+        if p.ann.get("fuse"):
+            note += "  [FUSED with join: one shard body]"
+        aggs = ", ".join(f"{op.name.lower()}({c})" for c, op in n.aggs)
+        lines.append(f"{pad}groupby [{', '.join(n.by)}] {aggs}{note}")
+    elif isinstance(n, ir.Sort):
+        keys = ", ".join(f"{k}{'^' if a else 'v'}"
+                         for k, a in zip(n.by, n.ascending))
+        lines.append(f"{pad}sort [{keys}]  [range shuffle]")
+    elif isinstance(n, ir.Limit):
+        lines.append(f"{pad}limit {n.n}  [gather]")
+    else:
+        lines.append(f"{pad}{n.kind}")
+    for c in p.children:
+        _render(plan, c, lines, depth + 1)

@@ -133,13 +133,27 @@ def _slo_key(kind: str, tenant: str) -> str:
 
 #: ops the service admits — each maps to a chunked-engine entry point
 #: accepting ``ctx=`` and ``pass_guard=`` (the cancellation hook)
-OPS = ("join", "join_groupby", "groupby", "sort")
+OPS = ("join", "join_groupby", "groupby", "sort", "plan")
+
+
+def _run_plan(plan, *, ctx=None, pass_guard=None, **kw):
+    """Serve runner for whole logical plans (``submit(tenant, "plan",
+    table.plan()...)``): executes through the plan optimizer/executor
+    and journals at PLAN granularity — one fingerprint for the whole op
+    chain, so a repeated multi-op query is one result-cache entry.
+    Lazy import: the plan package pulls the optimizer stack, which a
+    serve-only process may never need."""
+    from .. import plan as plan_mod
+
+    return plan_mod.run_service(plan, ctx=ctx, pass_guard=pass_guard, **kw)
+
 
 _RUNNERS = {
     "join": exec_mod.chunked_join,
     "join_groupby": exec_mod.chunked_join_groupby_tables,
     "groupby": exec_mod.chunked_groupby,
     "sort": exec_mod.chunked_sort,
+    "plan": _run_plan,
 }
 
 QUEUED = "queued"
@@ -234,6 +248,9 @@ def _estimate_request_bytes(args, kwargs) -> int:
             for v in a.values():
                 nb = getattr(np.asarray(v), "nbytes", 0)
                 total += int(nb)
+        elif hasattr(a, "approx_input_bytes"):
+            # a LogicalPlan: pruned-scan buffer metadata, host-only
+            total += int(a.approx_input_bytes())
         else:
             nbytes = getattr(a, "nbytes", None)
             if isinstance(nbytes, (int, np.integer)):

@@ -587,7 +587,21 @@ class Table:
 
             left_sh = par_ops.shuffle(self, cfg.left_on)
             right_sh = par_ops.shuffle(other, cfg.right_on)
-            return _local_join(left_sh, right_sh, cfg)
+            out = _local_join(left_sh, right_sh, cfg)
+            _stamp_join_partitioning(out, self, other, cfg)
+            return out
+
+    def plan(self) -> "LogicalPlan":
+        """Start a lazy logical plan at this table (cylon_tpu.plan): a
+        multi-op pipeline built this way runs through the rule-based
+        optimizer — shuffle elision from tracked partitioning, column
+        pruning before plane packing, fused post-shuffle local kernels
+        — instead of one eager exchange per op.  ``execute()`` runs it,
+        ``explain()`` shows every decision, and the durable journal /
+        serve result cache fingerprint the whole plan as one unit."""
+        from .plan import LogicalPlan
+
+        return LogicalPlan.scan(self)
 
     # -- set ops -------------------------------------------------------
     def union(self, other: "Table") -> "Table":
@@ -1186,6 +1200,28 @@ def _check_join_keys(left: Table, right: Table, cfg: JoinConfig) -> JoinConfig:
                 f"join key type mismatch: {left.names[li]}:{lt} vs "
                 f"{right.names[ri]}:{rt} (cast the keys to a common type)")
     return cfg
+
+
+def _stamp_join_partitioning(out: Table, left: Table, right: Table,
+                             cfg: JoinConfig) -> None:
+    """Record the shuffled join's output partitioning as a tracked
+    property (the planner's shuffle-elision substrate).  Which side's
+    key names survive as valid hash alternatives — INNER both, LEFT
+    left keys, RIGHT right keys, FULL_OUTER neither — is the planner's
+    single-sourced rule, shared so the eager stamp and the optimizer's
+    derived property can never disagree."""
+    from .plan.optimizer import join_partition_alternatives
+
+    how = {JoinType.INNER: "inner", JoinType.LEFT: "left",
+           JoinType.RIGHT: "right", JoinType.FULL_OUTER: "outer"}[
+        cfg.join_type]
+    alts = join_partition_alternatives(
+        how, left.names, right.names,
+        [left.names[i] for i in cfg.left_on],
+        [right.names[i] for i in cfg.right_on],
+        cfg.left_prefix, cfg.right_prefix)
+    if alts:
+        out._partitioning = ("hash", alts, left.num_shards)
 
 
 def _join_output_names(left: Table, right: Table, cfg: JoinConfig) -> Tuple[str, ...]:

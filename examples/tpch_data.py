@@ -33,6 +33,7 @@ Q1_CUTOFF = 2190  # ~1998-09-02 (1998-12-01 minus 90 days)
 Q5_LO, Q5_HI = 730, 1095  # orderdate in [1994-01-01, 1995-01-01)
 Q3_DATE = 1168             # 1995-03-15 (Q3's order/ship cutoff)
 Q6_LO, Q6_HI = 730, 1095   # shipdate in [1994-01-01, 1995-01-01)
+Q10_LO, Q10_HI = 639, 730  # orderdate in [1993-10-01, 1994-01-01)
 MKTSEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
                "MACHINERY"]
 

@@ -89,6 +89,92 @@ def run(sf: float = 0.01, world: int | None = None, seed: int = 0,
                 world=ctx.GetWorldSize(), nations=len(res), sf=sf)
 
 
+def run_plan(sf: float = 0.01, world: int | None = None, seed: int = 0,
+             check: bool = True, compare_eager: bool = False) -> dict:
+    """Q5 through the logical planner: the same 6-table pipeline built
+    lazily with ``Table.plan()``.  The nation→region join is ordered
+    LAST and the group keys include n_regionkey, so the rows reaching
+    the group-by are already hash-partitioned on a subset of its keys —
+    the planner elides the final shuffle and fuses the region probe +
+    ASIA filter + revenue derive + local aggregate into one shard body.
+    n_name tie-breaks the ordering (engine f32 vs pandas f64 revenue,
+    the PR-5 tpch_q3 discipline)."""
+    from cylon_tpu import config
+    from cylon_tpu.obs import metrics as obs_metrics
+    from cylon_tpu.plan import col, lit
+
+    ctx = default_ctx(world)
+    rng = np.random.default_rng(seed)
+    raw_c = tpch_data.customer(sf, rng)
+    raw_o = tpch_data.orders(sf, rng)
+    raw_l = tpch_data.lineitem(sf, rng, q5_keys=True,
+                               orders_rows=len(raw_o["o_orderkey"]))
+    raw_s = tpch_data.supplier(sf, rng)
+    raw_n = tpch_data.nation()
+    raw_r = tpch_data.region()
+
+    cust = table_from_arrays(raw_c, ctx)
+    orde = table_from_arrays(raw_o, ctx)
+    line = table_from_arrays(raw_l, ctx)
+    supp = table_from_arrays(raw_s, ctx)
+    nati = table_from_arrays(raw_n, ctx)
+    regi = table_from_arrays(raw_r, ctx)
+    rows = line.row_count + orde.row_count + cust.row_count
+    asia_key = tpch_data.REGIONS.index("ASIA")
+
+    plan = (cust.plan()
+            .join(orde.plan()
+                  .filter((col("o_orderdate") >= tpch_data.Q5_LO)
+                          & (col("o_orderdate") < tpch_data.Q5_HI)),
+                  left_on="c_custkey", right_on="o_custkey")
+            .join(line.plan(), left_on="o_orderkey", right_on="l_orderkey")
+            .join(supp.plan(), left_on="l_suppkey", right_on="s_suppkey")
+            .filter(col("c_nationkey") == col("s_nationkey"))
+            .join(nati.plan(), left_on="c_nationkey",
+                  right_on="n_nationkey")
+            .join(regi.plan(), left_on="n_regionkey",
+                  right_on="r_regionkey")
+            .filter(col("r_regionkey") == lit(asia_key))
+            .with_column("revenue",
+                         col("l_extendedprice") * (lit(1.0)
+                                                   - col("l_discount")))
+            .groupby(["n_regionkey", "n_name"], {"revenue": ["sum"]})
+            .project(["n_name", "sum_revenue"])
+            .sort(["sum_revenue", "n_name"], ascending=[False, True]))
+
+    elided0 = obs_metrics.counter_value("plan.shuffles_elided")
+    t0 = time.perf_counter()
+    res = plan.execute().to_pandas().reset_index(drop=True)
+    dt = time.perf_counter() - t0
+    elided = int(obs_metrics.counter_value("plan.shuffles_elided")
+                 - elided0)
+
+    eager_identical = None
+    if compare_eager:
+        with config.knob_env(CYLON_TPU_PLAN="0"):
+            eager = plan.execute().to_pandas().reset_index(drop=True)
+        for c in res.columns:
+            np.testing.assert_array_equal(
+                res[c].to_numpy(), eager[c].to_numpy(),
+                err_msg=f"planner vs eager mismatch in {c}")
+        eager_identical = True
+
+    if check:
+        exp = _pandas_golden(raw_c, raw_o, raw_l, raw_s, raw_n, raw_r,
+                             asia_key)
+        assert len(res) == len(exp), (len(res), len(exp))
+        got = dict(zip(res["n_name"], res["sum_revenue"]))
+        for name, rev in zip(exp["n_name"], exp["revenue"]):
+            np.testing.assert_allclose(got[name], rev, rtol=1e-4)
+
+    rec = emit("tpch_q5_plan", rows=rows, seconds=dt,
+               rows_per_sec=rows / dt, world=ctx.GetWorldSize(),
+               nations=len(res), sf=sf, shuffles_elided=elided)
+    if eager_identical is not None:
+        rec["eager_bit_identical"] = eager_identical
+    return rec
+
+
 def run_ooc(sf: float = 1.0, passes: int | None = None, seed: int = 0,
             check: bool = False) -> dict:
     """Q5 at scales past one chip's HBM: the same five-way join + group-by

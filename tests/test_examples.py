@@ -35,6 +35,26 @@ def test_tpch_q6_example():
     assert rec["revenue"] > 0
 
 
+def test_tpch_q10_planner_example():
+    # 4-way join through the logical planner: pandas-checked (check=True
+    # inside, c_custkey tie-break), at least one elided shuffle, and
+    # bit-identical to the eager per-op execution of the same plan
+    from examples import tpch_q10
+
+    rec = tpch_q10.run(sf=0.004, compare_eager=True)
+    assert rec["top"] == 20
+    assert rec["shuffles_elided"] >= 1, rec
+    assert rec["eager_bit_identical"] is True
+
+
+def test_tpch_q5_planner_example():
+    from examples import tpch_q5
+
+    rec = tpch_q5.run_plan(sf=0.004)
+    assert rec["nations"] >= 1
+    assert rec["shuffles_elided"] >= 1, rec
+
+
 def test_tpch_q5_example():
     from examples import tpch_q5
 

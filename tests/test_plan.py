@@ -1,0 +1,528 @@
+"""ISSUE-9: the logical query planner — IR/expressions, optimizer rules
+(shuffle elision, column pruning, scan sharing, fusion), executor
+bit-identity against the eager per-op lowering and the pandas oracle,
+collective-launch accounting, plan-granularity journal replay, and the
+serve-layer plan op."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table, config
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.plan import col, lit
+from cylon_tpu.status import CylonError
+
+
+def _mk(ctx, rng, n=240, nkeys=24, wide=False):
+    d = {"k": rng.integers(0, nkeys, n).astype(np.int32),
+         "v": rng.random(n).astype(np.float32),
+         "w": rng.random(n).astype(np.float32)}
+    if wide:
+        for i in range(9):
+            d[f"pad{i}"] = rng.random(n).astype(np.float32)
+    return d, Table.from_numpy(list(d), list(d.values()), ctx=ctx)
+
+
+def _mk_right(ctx, rng, n=240, nkeys=24):
+    d = {"k2": rng.integers(0, nkeys, n).astype(np.int32),
+         "u": rng.random(n).astype(np.float32)}
+    return d, Table.from_numpy(list(d), list(d.values()), ctx=ctx)
+
+
+def _sorted_pd(t, by):
+    return t.to_pandas().sort_values(by).reset_index(drop=True)
+
+
+def _counters(names):
+    snap = obs_metrics.snapshot()["counters"]
+    return {n: snap.get(n, 0) for n in names}
+
+
+def _deltas(before, names):
+    after = _counters(names)
+    return {n: after[n] - before[n] for n in names}
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+
+def test_expr_spec_columns_render():
+    e = (col("a") * (lit(1.0) - col("b"))) >= lit(2)
+    assert e.columns() == {"a", "b"}
+    spec = e.spec()
+    assert spec[0] == "bin" and spec[1] == "ge"
+    # specs are pure primitive tuples (fingerprintable by durable)
+    def prim(x):
+        if isinstance(x, tuple):
+            return all(prim(i) for i in x)
+        return isinstance(x, (str, int, float, bool, type(None)))
+    assert prim(spec)
+    from cylon_tpu.plan.expr import render
+
+    assert render(e) == "((a * (1.0 - b)) >= 2)"
+
+
+def test_expr_literal_subtrees_constant_fold(local_ctx):
+    # found by the verify drive: lit-op-lit subtrees (lit(1.0) - lit(0.1))
+    # must fold on the host, not die at evaluation
+    e = col("v") * (lit(1.0) - lit(0.1))
+    from cylon_tpu.plan.expr import render
+
+    assert render(e) == "(v * 0.9)"
+    rng = np.random.default_rng(0)
+    raw, t = _mk(local_ctx, rng, n=32)
+    out = t.plan().with_column("net", e).execute()
+    np.testing.assert_allclose(out.to_pandas()["net"],
+                               raw["v"] * np.float32(0.9), rtol=1e-6)
+
+
+def test_logical_with_folded_literal_operand(local_ctx):
+    # review finding: a predicate whose subexpression constant-folds to
+    # a bool literal (pred & (lit(1) < lit(2))) must evaluate, not die
+    rng = np.random.default_rng(0)
+    raw, t = _mk(local_ctx, rng, n=64)
+    out = (t.plan().filter((col("k") > 2) & (lit(1) < lit(2)))
+           .execute())
+    assert out.row_count == int((raw["k"] > 2).sum())
+    none = (t.plan().filter((col("k") > 2) & (lit(1) > lit(2)))
+            .execute())
+    assert none.row_count == 0
+    # a FULLY constant predicate is rejected at construction, clearly
+    with pytest.raises(CylonError, match="constant"):
+        t.plan().filter(lit(1) < lit(2))
+
+
+def test_expr_no_truth_value():
+    with pytest.raises(CylonError):
+        bool(col("a") > 1)
+
+
+def test_plan_filter_rejects_lambda(local_ctx):
+    rng = np.random.default_rng(0)
+    _, t = _mk(local_ctx, rng)
+    with pytest.raises(CylonError):
+        t.plan().filter(lambda r: r.k > 1)
+
+
+def test_expr_filter_matches_eager_select(local_ctx):
+    rng = np.random.default_rng(1)
+    raw, t = _mk(local_ctx, rng)
+    planned = (t.plan().filter((col("k") >= lit(5)) & (col("v") < lit(0.5)))
+               .execute())
+    eager = t.select(lambda r: (r.k >= 5) & (r.v < 0.5))
+    a, b = _sorted_pd(planned, ["k", "v"]), _sorted_pd(eager, ["k", "v"])
+    pd.testing.assert_frame_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# builder / schema
+# ---------------------------------------------------------------------------
+
+
+def test_builder_schema_and_errors(local_ctx):
+    rng = np.random.default_rng(2)
+    _, t = _mk(local_ctx, rng)
+    _, r = _mk_right(local_ctx, rng)
+    p = t.plan().join(r, left_on="k", right_on="k2")
+    assert p.names == ("k", "v", "w", "k2", "u")
+    # collision prefixing matches the eager join's naming
+    p2 = t.plan().join(t, on="k")
+    assert p2.names[:3] == ("l_k", "l_v", "l_w")
+    g = p.groupby(["k"], {"u": ["sum", "mean"]})
+    assert g.names == ("k", "sum_u", "mean_u")
+    with pytest.raises(CylonError):
+        p.project(["nope"])
+    with pytest.raises(CylonError):
+        p.groupby(["nope"], {"u": "sum"})
+    with pytest.raises(CylonError):
+        t.plan().filter(col("missing") > 1)
+
+
+def test_explain_renders_decisions(ctx4):
+    rng = np.random.default_rng(3)
+    _, t = _mk(ctx4, rng, wide=True)
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"u": "sum"}))
+    s = q.explain()
+    assert "shuffle ELIDED" in s and "FUSED with join" in s
+    assert "pruned 12->1 cols" in s, s  # only k survives the left scan
+    e = q.explain(optimized=False)
+    assert "ELIDED" not in e and "mode=eager" in e
+
+
+# ---------------------------------------------------------------------------
+# optimizer decisions
+# ---------------------------------------------------------------------------
+
+
+def test_optimizer_annotations(ctx4):
+    from cylon_tpu.plan import optimizer
+
+    rng = np.random.default_rng(4)
+    _, t = _mk(ctx4, rng, wide=True)
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"u": "sum"}))
+    phys = optimizer.optimize(q, enabled=True)
+    assert phys.shuffles_elided == 1          # the group-by
+    assert phys.columns_pruned == 11          # only k survives the left scan
+    agg = phys.root
+    assert agg.ann["mode"] == "elided" and agg.ann.get("fuse")
+    join = agg.children[0]
+    assert join.ann["left"][0] == "shuffle"
+    assert join.ann["right"][0] == "shuffle"
+    # eager plan: nothing pruned, nothing elided
+    eager = optimizer.optimize(q, enabled=False)
+    assert eager.shuffles_elided == 0 and eager.columns_pruned == 0
+    assert eager.root.ann["mode"] == "eager"
+
+
+def test_optimizer_shares_self_join_scan(ctx4):
+    from cylon_tpu.plan import optimizer
+
+    rng = np.random.default_rng(5)
+    _, t = _mk(ctx4, rng)
+    q = (t.plan().project(["k", "v"])
+         .join(t.plan().project(["k"]), on="k")
+         .groupby(["l_k"], {"v": "sum"}))
+    phys = optimizer.optimize(q, enabled=True)
+    join = phys.root.children[0]
+    assert join.ann.get("shared") is True
+    assert phys.shuffles_elided == 2  # shared scan + elided group-by
+
+
+def test_optimizer_respects_prepartitioned_scan(ctx4):
+    from cylon_tpu.plan import optimizer
+
+    rng = np.random.default_rng(6)
+    _, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    ts = t.shuffle(["k"])
+    assert getattr(ts, "_partitioning", None) == ("hash", (("k",),), 4)
+    q = ts.plan().join(r, left_on="k", right_on="k2")
+    phys = optimizer.optimize(q, enabled=True)
+    assert phys.root.ann["left"][0] == "elide"
+    assert phys.root.ann["right"] == ("shuffle", ("k2",))
+
+
+def test_outer_join_output_not_treated_partitioned(ctx4):
+    from cylon_tpu.plan import optimizer
+
+    rng = np.random.default_rng(7)
+    _, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2", how="outer")
+         .groupby(["k"], {"u": "sum"}))
+    phys = optimizer.optimize(q, enabled=True)
+    # null keys from either side break the placement property: the
+    # group-by must NOT elide its shuffle after a full-outer join
+    assert phys.root.ann["mode"] == "eager"
+
+
+def test_nunique_never_elides(ctx4):
+    from cylon_tpu.plan import optimizer
+
+    rng = np.random.default_rng(8)
+    _, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"u": "nunique"}))
+    phys = optimizer.optimize(q, enabled=True)
+    assert phys.root.ann["mode"] == "eager"
+    assert not phys.root.ann.get("fuse")
+
+
+# ---------------------------------------------------------------------------
+# execution: bit-identity + oracle across worlds
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world_fixture", ["local_ctx", "ctx2", "ctx4"])
+def test_join_groupby_planner_vs_eager_vs_pandas(world_fixture, request):
+    ctx = request.getfixturevalue(world_fixture)
+    rng = np.random.default_rng(9)
+    raw_l, t = _mk(ctx, rng)
+    raw_r, r = _mk_right(ctx, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .with_column("rev", col("v") * (lit(1.0) - col("u")))
+         .groupby(["k"], {"rev": ["sum"], "w": ["mean"], "u": ["min"]}))
+    planned = q.execute()
+    with config.knob_env(CYLON_TPU_PLAN="0"):
+        eager = q.execute()
+    a, b = _sorted_pd(planned, ["k"]), _sorted_pd(eager, ["k"])
+    # bit-identical: exact equality, float bits included
+    pd.testing.assert_frame_equal(a, b)
+    j = pd.DataFrame(raw_l).merge(pd.DataFrame(raw_r), left_on="k",
+                                  right_on="k2")
+    j["rev"] = j.v * (1.0 - j.u)
+    exp = j.groupby("k").agg(sum_rev=("rev", "sum"), mean_w=("w", "mean"),
+                             min_u=("u", "min")).reset_index()
+    assert len(a) == len(exp)
+    np.testing.assert_allclose(a["sum_rev"], exp["sum_rev"], rtol=1e-4)
+    np.testing.assert_allclose(a["mean_w"], exp["mean_w"], rtol=1e-4)
+    np.testing.assert_allclose(a["min_u"], exp["min_u"], rtol=1e-6)
+
+
+def test_fused_filter_in_chain_matches_eager(ctx4):
+    rng = np.random.default_rng(10)
+    raw_l, t = _mk(ctx4, rng)
+    raw_r, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .filter(col("u") < lit(0.6))
+         .with_column("rev", col("v") * col("u"))
+         .groupby(["k"], {"rev": "sum"}))
+    planned = q.execute()
+    with config.knob_env(CYLON_TPU_PLAN="0"):
+        eager = q.execute()
+    pd.testing.assert_frame_equal(_sorted_pd(planned, ["k"]),
+                                  _sorted_pd(eager, ["k"]))
+    j = pd.DataFrame(raw_l).merge(pd.DataFrame(raw_r), left_on="k",
+                                  right_on="k2")
+    j = j[j.u < 0.6]
+    j["rev"] = j.v * j.u
+    exp = j.groupby("k").rev.sum().reset_index()
+    np.testing.assert_allclose(_sorted_pd(planned, ["k"])["sum_rev"],
+                               exp["rev"], rtol=1e-4)
+
+
+def test_sort_limit_pipeline(ctx4):
+    rng = np.random.default_rng(11)
+    raw_l, t = _mk(ctx4, rng)
+    raw_r, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"u": "sum"})
+         .sort(["sum_u", "k"], ascending=[False, True])
+         .limit(5))
+    planned = q.execute()
+    with config.knob_env(CYLON_TPU_PLAN="0"):
+        eager = q.execute()
+    pa, pb = planned.to_pandas(), eager.to_pandas()
+    pd.testing.assert_frame_equal(pa.reset_index(drop=True),
+                                  pb.reset_index(drop=True))
+    j = pd.DataFrame(raw_l).merge(pd.DataFrame(raw_r), left_on="k",
+                                  right_on="k2")
+    exp = (j.groupby("k").u.sum().reset_index()
+           .sort_values(["u", "k"], ascending=[False, True])
+           .head(5).reset_index(drop=True))
+    np.testing.assert_array_equal(pa["k"].to_numpy(), exp["k"].to_numpy())
+    np.testing.assert_allclose(pa["sum_u"], exp["u"], rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# collective accounting: the 1-vs-3 headline
+# ---------------------------------------------------------------------------
+
+_LAUNCH_KEYS = ("shuffle.exchanges", "shuffle.collective_launches",
+                "shuffle.counts_gathers")
+
+
+def test_self_join_groupby_one_packed_exchange(ctx4):
+    """The acceptance shape: join→groupby on the same key executes
+    exactly ONE packed all_to_all (+1 all_gather) with the planner on —
+    scan sharing + elision — vs three exchanges eager."""
+    rng = np.random.default_rng(12)
+    _, t = _mk(ctx4, rng)
+    q = (t.plan().project(["k", "v"])
+         .join(t.plan().project(["k"]), on="k")
+         .groupby(["l_k"], {"v": "sum"}))
+    with config.knob_env(CYLON_TPU_SHUFFLE_PACK="1"):
+        before = _counters(_LAUNCH_KEYS)
+        planned = q.execute()
+        d1 = _deltas(before, _LAUNCH_KEYS)
+        with config.knob_env(CYLON_TPU_PLAN="0"):
+            before = _counters(_LAUNCH_KEYS)
+            eager = q.execute()
+            d2 = _deltas(before, _LAUNCH_KEYS)
+    assert d1 == {"shuffle.exchanges": 1, "shuffle.collective_launches": 1,
+                  "shuffle.counts_gathers": 1}, d1
+    assert d2["shuffle.exchanges"] == 3, d2
+    assert d2["shuffle.collective_launches"] == 3, d2
+    pd.testing.assert_frame_equal(_sorted_pd(planned, ["l_k"]),
+                                  _sorted_pd(eager, ["l_k"]))
+
+
+def test_two_table_join_groupby_two_vs_three_exchanges(ctx4):
+    rng = np.random.default_rng(13)
+    _, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"u": "sum"}))
+    before = _counters(_LAUNCH_KEYS)
+    q.execute()
+    d1 = _deltas(before, _LAUNCH_KEYS)
+    with config.knob_env(CYLON_TPU_PLAN="0"):
+        before = _counters(_LAUNCH_KEYS)
+        q.execute()
+        d2 = _deltas(before, _LAUNCH_KEYS)
+    assert d1["shuffle.exchanges"] == 2, d1    # one per input; agg elided
+    assert d2["shuffle.exchanges"] == 3, d2    # + the partial shuffle
+
+
+def test_pruning_shrinks_bytes_sent(ctx4):
+    """A projected 3-of-12-column query must move measurably fewer
+    bytes through the exchange than the eager unprojected run."""
+    rng = np.random.default_rng(14)
+    _, t = _mk(ctx4, rng, wide=True)          # 12 columns
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"v": "sum", "w": "sum"}))  # reads 3 of 12
+    with config.knob_env(CYLON_TPU_SHUFFLE_PACK="1"):
+        before = _counters(("shuffle.bytes_sent",))
+        q.execute()
+        planned = _deltas(before, ("shuffle.bytes_sent",))
+        with config.knob_env(CYLON_TPU_PLAN="0"):
+            before = _counters(("shuffle.bytes_sent",))
+            q.execute()
+            eager = _deltas(before, ("shuffle.bytes_sent",))
+    # left plane: 12 cols ≈ 13 words pruned to 3 cols ≈ 4 words, and the
+    # eager run pays a third exchange on top — require a >2x drop
+    assert planned["shuffle.bytes_sent"] * 2 < eager["shuffle.bytes_sent"], (
+        planned, eager)
+
+
+def test_shuffles_elided_counter(ctx4):
+    rng = np.random.default_rng(15)
+    _, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"u": "sum"}))
+    before = _counters(("plan.shuffles_elided",))
+    q.execute()
+    d = _deltas(before, ("plan.shuffles_elided",))
+    assert d["plan.shuffles_elided"] == 1
+
+
+# ---------------------------------------------------------------------------
+# plan-granularity durable replay + serve
+# ---------------------------------------------------------------------------
+
+
+def test_journal_replay_zero_compiles(ctx4, tmp_path):
+    rng = np.random.default_rng(16)
+    _, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"u": "sum"}))
+    keys = ("plan.cache_hit", "plan_cache.miss", "plan_cache.hit",
+            "shuffle.exchanges")
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        first = q.execute()
+        before = _counters(keys)
+        second = q.execute()
+        d = _deltas(before, keys)
+    # repeated plan fingerprint => zero compiles (no plan-cache traffic
+    # at all), zero device passes (no exchanges), served from spill
+    assert d == {"plan.cache_hit": 1, "plan_cache.miss": 0,
+                 "plan_cache.hit": 0, "shuffle.exchanges": 0}, d
+    pd.testing.assert_frame_equal(_sorted_pd(first, ["k"]),
+                                  _sorted_pd(second, ["k"]))
+
+
+def test_fingerprint_tracks_content_and_knobs(ctx4):
+    rng = np.random.default_rng(17)
+    raw, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    q = t.plan().join(r, left_on="k", right_on="k2").groupby(
+        ["k"], {"u": "sum"})
+    fp1 = q.fingerprint()
+    assert fp1 == q.fingerprint()
+    # different input content -> different fingerprint
+    raw2 = dict(raw)
+    raw2["v"] = raw2["v"] + 1.0
+    t2 = Table.from_numpy(list(raw2), list(raw2.values()), ctx=ctx4)
+    q2 = t2.plan().join(r, left_on="k", right_on="k2").groupby(
+        ["k"], {"u": "sum"})
+    # v is PRUNED from this plan: its content must NOT change the key...
+    assert q2.fingerprint() == fp1
+    raw3 = dict(raw)
+    raw3["k"] = (raw3["k"] + 1).astype(np.int32)
+    t3 = Table.from_numpy(list(raw3), list(raw3.values()), ctx=ctx4)
+    q3 = t3.plan().join(r, left_on="k", right_on="k2").groupby(
+        ["k"], {"u": "sum"})
+    # ...but a kept column's content must
+    assert q3.fingerprint() != fp1
+    # trace-scope knobs ride the fingerprint (CY108's invariant)
+    with config.knob_env(CYLON_TPU_ACCUM="wide"):
+        assert q.fingerprint() != fp1
+
+
+def test_serve_plan_op_and_cache_hit(ctx4, tmp_path):
+    from cylon_tpu.serve import QueryService
+
+    rng = np.random.default_rng(18)
+    _, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    q = (t.plan().join(r, left_on="k", right_on="k2")
+         .groupby(["k"], {"u": "sum"}))
+    assert q.approx_input_bytes() > 0
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        with QueryService() as svc:
+            tk = svc.submit("tenant-a", "plan", q)
+            frame, stats = tk.result(timeout=300)
+            assert stats["parts_run"] == 1 and not stats["cache_hit"]
+            tk2 = svc.submit("tenant-a", "plan", q)
+            frame2, stats2 = tk2.result(timeout=300)
+            assert tk2.cache_hit, stats2
+            st = svc.stats()
+    assert st["completed"] == 2 and st["cache_hits"] == 1, st
+    a = pd.DataFrame(frame).sort_values("k").reset_index(drop=True)
+    b = pd.DataFrame(frame2).sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# misc semantics
+# ---------------------------------------------------------------------------
+
+
+def test_string_filter_and_group_key(ctx4):
+    rng = np.random.default_rng(19)
+    n = 160
+    raw = {"k": rng.integers(0, 12, n).astype(np.int32),
+           "tag": np.array(["A", "N", "R"], object)[rng.integers(0, 3, n)],
+           "v": rng.random(n).astype(np.float32)}
+    t = Table.from_numpy(list(raw), list(raw.values()), ctx=ctx4)
+    q = (t.plan().filter(col("tag") == "R")
+         .groupby(["k"], {"v": "sum"}))
+    planned = q.execute()
+    with config.knob_env(CYLON_TPU_PLAN="0"):
+        eager = q.execute()
+    pd.testing.assert_frame_equal(_sorted_pd(planned, ["k"]),
+                                  _sorted_pd(eager, ["k"]))
+    j = pd.DataFrame(raw)
+    exp = j[j.tag == "R"].groupby("k").v.sum().reset_index()
+    got = _sorted_pd(planned, ["k"])
+    np.testing.assert_allclose(got["sum_v"], exp["v"], rtol=1e-4)
+
+
+def test_dead_derive_is_pruned(ctx4):
+    from cylon_tpu.plan import optimizer
+
+    rng = np.random.default_rng(20)
+    _, t = _mk(ctx4, rng)
+    q = (t.plan().with_column("dead", col("v") * 2.0)
+         .project(["k", "w"]))
+    phys = optimizer.optimize(q, enabled=True)
+    derive = phys.root.children[0]
+    assert derive.ann.get("dead") is True
+    out = q.execute()
+    assert out.column_names == ["k", "w"]
+
+
+def test_plan_result_partitioning_stamp(ctx4):
+    rng = np.random.default_rng(21)
+    _, t = _mk(ctx4, rng)
+    _, r = _mk_right(ctx4, rng)
+    out = (t.plan().join(r, left_on="k", right_on="k2")
+           .groupby(["k"], {"u": "sum"}).execute())
+    part = getattr(out, "_partitioning", None)
+    assert part is not None and part[0] == "hash" and part[2] == 4
+    # feeding the result into a NEW plan elides again
+    from cylon_tpu.plan import optimizer
+
+    q2 = out.plan().groupby(["k"], {"sum_u": "max"})
+    phys = optimizer.optimize(q2, enabled=True)
+    assert phys.root.ann["mode"] == "elided"

@@ -366,6 +366,68 @@ def test_cy107_only_fires_under_the_serve_package(tmp_path):
     assert "CY107" not in {f.rule for f in found}
 
 
+def _scan_plan(tmp_path, src, name="executor.py"):
+    """CY108 fixtures must live under cylon_tpu/plan/ for the module
+    name to resolve into the planner namespace."""
+    d = tmp_path / "cylon_tpu" / "plan"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(src))
+    return astlint.scan_paths([str(p)])
+
+
+def test_cy108_knob_read_without_fingerprint_coverage(tmp_path):
+    found = _scan_plan(tmp_path, """\
+        from cylon_tpu.parallel.plane import pack_enabled
+
+        def optimize(plan):
+            return pack_enabled()
+
+        def plan_fingerprint(plan):
+            return hash(plan)  # trace knobs NOT covered
+        """)
+    assert [(f.rule, f.line) for f in found if f.rule == "CY108"] \
+        == [("CY108", 3)]
+    assert "CYLON_TPU_SHUFFLE_PACK" in found[0].msg
+    assert "stale" in found[0].msg
+
+
+def test_cy108_token_complete_fingerprint_is_clean(tmp_path):
+    found = _scan_plan(tmp_path, """\
+        from cylon_tpu import config
+        from cylon_tpu.parallel.plane import pack_enabled
+
+        def optimize(plan):
+            return pack_enabled()
+
+        def plan_fingerprint(plan):
+            return hash((plan, config.trace_cache_token()))
+        """)
+    assert "CY108" not in {f.rule for f in found}
+
+
+def test_cy108_missing_fingerprint_builder_fires(tmp_path):
+    # a plan package with NO fingerprint builder at all: the executor
+    # reading a trace knob has nothing covering it
+    found = _scan_plan(tmp_path, """\
+        from cylon_tpu.precision import narrow
+
+        def _exec_agg(t):
+            return narrow()
+        """)
+    assert any(f.rule == "CY108" for f in found)
+
+
+def test_cy108_only_fires_under_the_plan_package(tmp_path):
+    found = _scan(tmp_path, """\
+        from cylon_tpu.parallel.plane import pack_enabled
+
+        def optimize(plan):
+            return pack_enabled()
+        """)
+    assert "CY108" not in {f.rule for f in found}
+
+
 def test_cy001_suppression_requires_justification(tmp_path):
     # no justification: the suppression itself is the finding (and does
     # not silence the underlying rule)

@@ -323,6 +323,43 @@ if [ $rc -ne 0 ]; then
   echo "serve smoke failed (rc=$rc); fix the query service before the full tree" >&2
   exit $rc
 fi
+# planner smoke (ISSUE-9): TPC-H Q10 (4-way join) through the logical
+# planner on the world-8 CPU mesh — the artifact JSON must record at
+# least one elided shuffle and the planned result must be bit-identical
+# to the eager per-op execution of the same query (compare_eager
+# asserts column-by-column exact equality inside the example) — catches
+# an optimizer/executor regression in ~2 min, before the full tree
+PT=$(mktemp -d /tmp/cylon_plan_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - "$PT" <<'PYEOF'
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from examples import tpch_q10
+
+rec = tpch_q10.run(sf=0.004, check=True, compare_eager=True)
+with open(f"{sys.argv[1]}/tpch_q10.json", "w") as fh:
+    json.dump(rec, fh, indent=1, sort_keys=True)
+PYEOF
+rc=$?
+if [ $rc -eq 0 ]; then
+  python - "$PT" <<'PYEOF'
+import json, sys
+rec = json.load(open(f"{sys.argv[1]}/tpch_q10.json"))
+assert rec["shuffles_elided"] >= 1, rec
+assert rec["eager_bit_identical"] is True, rec
+assert rec["top"] == 20, rec
+print(f"planner smoke ok: q10 elided {rec['shuffles_elided']} shuffle(s), "
+      f"bit-identical to eager, top-{rec['top']} matches pandas")
+PYEOF
+  rc=$?
+fi
+rm -rf "$PT"
+if [ $rc -ne 0 ]; then
+  echo "planner smoke failed (rc=$rc); fix the query planner before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

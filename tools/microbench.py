@@ -30,8 +30,78 @@ enable_persistent_compile_cache()
 from cylon_tpu.obs import export as obs_export  # noqa: E402
 from cylon_tpu.obs import spans as obs_spans  # noqa: E402
 
-N = int(sys.argv[1]) if len(sys.argv) > 1 else (1 << 26)
+_POS_ARGS = [a for a in sys.argv[1:] if not a.startswith("--")]
+N = int(_POS_ARGS[0]) if _POS_ARGS else (1 << 26)
 REPS = 3
+
+
+def _plan_ab(n_rows: int) -> bool:
+    """ISSUE-9 A/B arm: join→groupby-on-same-key through the logical
+    planner (CYLON_TPU_PLAN on) vs eager per-op lowering (off), on a
+    mesh over every visible device.  Reports wall time (best of 3),
+    collective launches, and shuffle.bytes_sent per arm — the planner's
+    shuffle elision + column pruning should cut both collective counts
+    (3 exchanges -> 2, or -> 1 for the shared-scan self-join) and bytes
+    (the 12-column left table prunes to 2 before plane packing)."""
+    from cylon_tpu import Table, config
+    from cylon_tpu.context import CylonContext, TPUConfig
+    from cylon_tpu.obs import metrics as obs_metrics
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        # nonzero exit so the battery's `||` CPU-mesh fallback actually
+        # fires — a silent rc=0 skip would leave the round with no A/B
+        print("plan-ab: needs >= 2 devices for a mesh; skipping",
+              flush=True)
+        return False
+    ctx = CylonContext.InitDistributed(TPUConfig(world_size=ndev))
+    r = np.random.default_rng(17)
+    # 12-column fact table: the planner prunes 10 dead columns before
+    # the exchange; the eager arm ships all 12
+    fact = {"k": r.integers(0, n_rows, n_rows).astype(np.int32),
+            "v": r.random(n_rows).astype(np.float32)}
+    for i in range(10):
+        fact[f"pad{i}"] = r.random(n_rows).astype(np.float32)
+    dim = {"k2": r.integers(0, n_rows, n_rows).astype(np.int32),
+           "w": r.random(n_rows).astype(np.float32)}
+    ft = Table.from_numpy(list(fact), list(fact.values()), ctx=ctx)
+    dt_ = Table.from_numpy(list(dim), list(dim.values()), ctx=ctx)
+    q = (ft.plan().join(dt_, left_on="k", right_on="k2")
+         .groupby(["k"], {"v": ["sum"], "w": ["sum"]}))
+    for label, mode in (("planner", "1"), ("eager", "0")):
+        with config.knob_env(CYLON_TPU_PLAN=mode):
+            q.execute()  # warm the stage caches
+            best, deltas = None, None
+            for _ in range(REPS):
+                before = dict(obs_metrics.snapshot()["counters"])
+                t0 = time.perf_counter()
+                out = q.execute()
+                out.row_count  # force completion
+                dt_s = time.perf_counter() - t0
+                after = dict(obs_metrics.snapshot()["counters"])
+                if best is None or dt_s < best:
+                    best = dt_s
+                    deltas = {k: after.get(k, 0) - before.get(k, 0)
+                              for k in ("shuffle.collective_launches",
+                                        "shuffle.counts_gathers",
+                                        "shuffle.bytes_sent",
+                                        "plan.shuffles_elided")}
+        print(f"plan-ab {label:8s} {best * 1e3:10.1f} ms  "
+              f"launches={int(deltas['shuffle.collective_launches'])} "
+              f"counts_gathers={int(deltas['shuffle.counts_gathers'])} "
+              f"bytes_sent={int(deltas['shuffle.bytes_sent'])} "
+              f"elided={int(deltas['plan.shuffles_elided'])}",
+              flush=True)
+    print("done", flush=True)
+    return True
+
+
+if "--plan-ab" in sys.argv:
+    _ok = _plan_ab(_POS_ARGS and int(_POS_ARGS[0]) or (1 << 20))
+    if _ok and obs_spans.events_enabled():
+        _tp, _mp = obs_export.export_all(prefix="microbench_plan_ab")
+        print(f"trace artifact: {_tp}", flush=True)
+    sys.exit(0 if _ok else 3)
 
 rng = np.random.default_rng(5)
 dev0 = jax.devices()[0]

@@ -154,6 +154,18 @@ JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     > "$OUT/scaling_pack1.json" 2> "$OUT/scaling_pack1.log"
 log "scaling pack=1 rc=$?"
 
+log "7e/9 planner A/B: join→groupby-on-same-key, CYLON_TPU_PLAN on vs off"
+# Tentpole knob (ISSUE 9): wall time + collective launches + bytes_sent
+# per arm.  Runs on the real accelerator mesh when one is up (the
+# collective-launch saving is a TPU effect); the same arm rides the
+# virtual CPU mesh otherwise so every battery round records the A/B.
+timeout 900 python tools/microbench.py 4194304 --plan-ab \
+    > "$OUT/plan_ab.txt" 2> "$OUT/plan_ab.log" \
+  || JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 900 python tools/microbench.py 1048576 --plan-ab \
+    > "$OUT/plan_ab.txt" 2>> "$OUT/plan_ab.log"
+log "plan A/B rc=$? $(head -c 200 "$OUT/plan_ab.txt" 2>/dev/null)"
+
 log "8/9 kernel smoke"
 timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"
 log "smoke rc=$?"
