@@ -41,6 +41,7 @@ RULES: Dict[str, str] = {
              "admission/scheduler control path",
     "CY108": "plan optimizer/executor reads a trace-scope knob the plan "
              "fingerprint does not cover",
+    "CY109": "realized-data jit layout missing from a plan cache key",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
 }
@@ -90,6 +91,15 @@ PLAN_ROOT_NAMES = frozenset({"optimize", "execute", "run_service"})
 PLAN_ROOT_PREFIXES = ("_rule_", "_lower", "_stage", "_exec", "_fused",
                       "plane_annotation")
 PLAN_FP_TOKEN = "fingerprint"
+
+#: producers whose RESULT is a jit shape/layout derived from REALIZED
+#: data (observed bit widths, dictionary sizes — the PR-10 compression
+#: spec), for CY109: a traced body closing over such a value bakes a
+#: data-dependent layout into the compiled program, so the value must
+#: ride the plan cache key alongside it — trace_cache_token() cannot
+#: cover it (it is data, not a knob), hence key-complete builders are
+#: NOT exempt.  Matched by final call identifier.
+REALIZED_LAYOUT_PRODUCERS = frozenset({"build_spec", "estimate_spec"})
 
 _SUPPRESS_RE = re.compile(
     r"#\s*cylint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--\s*(\S.*))?\s*$")
@@ -766,6 +776,89 @@ def _check_plan_keys(prog: _Program, mod: _Module) -> None:
                 "config.trace_cache_token() inside the plan builder"))
 
 
+def _check_realized_layout_keys(prog: _Program, mod: _Module) -> None:
+    """CY109: a plan-builder call whose traced body closes over a value
+    produced by a realized-layout producer (``plane.build_spec`` /
+    ``estimate_spec`` — observed bit widths, dictionary sizes), while the
+    cache-key expression at that call site never mentions the value.
+
+    The invariant (the PR-3 stale-program bug class lifted to
+    data-derived layout): the compression spec is static layout baked
+    into the traced program, but unlike a knob it changes with the DATA
+    — ``trace_cache_token()`` cannot cover it, so a key-complete builder
+    is not exempt.  Omitting it would decode a new value range under a
+    stale program's field layout: silently wrong bytes, not a crash."""
+    bound = _names_bound_to_realized(mod)
+    if not bound:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        resolved = _resolve(dotted, mod.aliases)
+        b = prog._builder_for(dotted, resolved, mod)
+        if b is None:
+            continue
+        if not (b.builder_key_idx is not None or b.builder_key_kw):
+            continue
+        key_expr = None
+        if (b.builder_key_idx is not None
+                and len(node.args) > b.builder_key_idx):
+            key_expr = node.args[b.builder_key_idx]
+        if key_expr is None:
+            for kw in node.keywords:
+                if kw.arg == "key":
+                    key_expr = kw.value
+        if key_expr is None or len(node.args) <= b.builder_fn_idx:
+            continue
+        fn_arg = node.args[b.builder_fn_idx]
+        if not isinstance(fn_arg, ast.Name) or fn_arg.id not in mod.funcs:
+            continue
+        body = mod.funcs[fn_arg.id].node
+        used = {n.id for n in ast.walk(body)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+        realized = used & set(bound)
+        if not realized:
+            continue
+        covered: Set[str] = set()
+        for n in ast.walk(key_expr):
+            if isinstance(n, ast.Name):
+                covered.add(n.id)
+            elif isinstance(n, ast.Call):
+                fin = (_dotted(n.func) or "").rsplit(".", 1)[-1]
+                if fin in REALIZED_LAYOUT_PRODUCERS:
+                    covered |= realized
+        missing = realized - covered
+        if missing:
+            mod.findings.append(Finding(
+                "CY109", mod.path, node.lineno,
+                f"jit-plan cache key omits realized-data layout value(s) "
+                f"{', '.join(sorted(missing))} baked into `{fn_arg.id}` — "
+                f"a data change would decode under a stale field layout "
+                f"(trace_cache_token cannot cover data-derived specs)",
+                "add the spec value to the key tuple at this call site; "
+                "observed bit-widths/dictionary sizes are static layout "
+                "and must retrace when the data moves"))
+
+
+def _names_bound_to_realized(mod: _Module) -> Dict[str, bool]:
+    """Names assigned (anywhere in the module, nested functions included)
+    from a realized-layout producer call."""
+    cached = getattr(mod, "_realized_names", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, bool] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fin = (_dotted(node.value.func) or "").rsplit(".", 1)[-1]
+            if fin in REALIZED_LAYOUT_PRODUCERS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = True
+    mod._realized_names = out  # type: ignore[attr-defined]
+    return out
+
+
 def _names_bound_to_knobs(mod: _Module) -> Dict[str, Set[str]]:
     cached = getattr(mod, "_knob_names", None)
     if cached is not None:
@@ -953,6 +1046,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_excepts(mod)
         _check_retries(prog, mod)
         _check_plan_keys(prog, mod)
+        _check_realized_layout_keys(prog, mod)
         _check_elastic_guards(prog, mod)
         _check_serve_blocking(prog, mod)
         _check_plan_fingerprint(prog, mod)

@@ -183,6 +183,17 @@ def _trace_shuffle_bucketed(ctx) -> Dict[str, Dict]:
             with _PlanRecorder(["shuffle"]) as rec:
                 par_ops.shuffle(t, (0,))
             out[label] = collect_counts(rec.jaxprs["shuffle"])
+    # ISSUE-10 pin: the COMPRESSED exchange stays 1 packed all_to_all +
+    # 1 count-matrix all_gather + at most 1 dictionary all_gather (the
+    # canonical frame's low-cardinality `tag` column dict-encodes, so
+    # the golden records exactly 2 all_gathers) — a regression back to
+    # per-buffer or per-dictionary-column collectives fails tier-1
+    with config.knob_env(CYLON_TPU_SHUFFLE="bucketed",
+                         CYLON_TPU_SHUFFLE_PACK="1",
+                         CYLON_TPU_SHUFFLE_COMPRESS="1"):
+        with _PlanRecorder(["shuffle"]) as rec:
+            par_ops.shuffle(t, (0,))
+        out["compressed"] = collect_counts(rec.jaxprs["shuffle"])
     return out
 
 
@@ -255,6 +266,26 @@ def _trace_shuffle_ragged(ctx) -> Optional[Dict[str, Dict]]:
                                   out_specs=P(PARTITION_AXIS),
                                   check_vma=False))
             out[label] = collect_counts(jax.make_jaxpr(f)(cols, targets))
+    # compressed ragged body (trace-only like the rest of this entry):
+    # spec from the host-side estimate — the same layout the device
+    # stats pass would derive on this single-controller grid
+    from ..parallel import plane as plane_mod
+
+    with config.knob_env(CYLON_TPU_SHUFFLE_PACK="1",
+                         CYLON_TPU_SHUFFLE_COMPRESS="1"):
+        spec = plane_mod.estimate_spec(cols, world=world,
+                                       shard_cap=n // world)
+
+        def cfn(cc, tgt):
+            out_cols, total = shuffle_mod.shuffle_shard_ragged(
+                cc, tgt, world, n, spec=spec)
+            return out_cols, jnp.reshape(total, (1,))
+
+        f = jax.jit(shard_map(cfn, mesh=ctx.mesh,
+                              in_specs=P(PARTITION_AXIS),
+                              out_specs=P(PARTITION_AXIS),
+                              check_vma=False))
+        out["compressed"] = collect_counts(jax.make_jaxpr(f)(cols, targets))
     return out
 
 

@@ -159,6 +159,18 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
        help="Shuffle exchange realization: one bit-packed u32 plane per "
             "collective (packed) vs one collective per buffer per column "
             "(perbuf); auto packs on TPU-family backends."),
+    _K("CYLON_TPU_SHUFFLE_COMPRESS", "enum", "auto", TRACE, cache_key=True,
+       choices=("1", "on", "0", "off", "auto"),
+       accessors=("cylon_tpu.parallel.plane.compress_enabled",),
+       help="Compress the packed shuffle plane between pack and exchange: "
+            "integer columns narrow to their observed range (offset + "
+            "reduced bit width), low-cardinality string columns exchange "
+            "dictionary codes plus one small all-gathered dictionary, "
+            "string data/length fields truncate to the observed extent — "
+            "bit-exact by construction.  Rides the packed plane "
+            "(CYLON_TPU_SHUFFLE_PACK); auto enables on TPU-family "
+            "backends.  The observed spec is static layout, so it also "
+            "enters every exchange plan cache key (cylint CY109)."),
     _K("CYLON_TPU_PERMUTE", "enum", "auto", TRACE, cache_key=True,
        choices=("scatter", "sort", "auto"),
        accessors=("cylon_tpu.ops.compact.permute_mode",),
@@ -249,6 +261,12 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
        help="Deterministic fault-injection plan: `site[@N][+][=kind]` "
             "entries joined by `;` (resilience.FaultPlan.parse), e.g. "
             "`pass_dispatch@2=oom;probe_spawn@1=timeout`; empty disables."),
+    _K("CYLON_TPU_FP_SALT", "str", "", RUNTIME,
+       help="Opaque salt mixed into every durable run/plan fingerprint.  "
+            "`bench.py --fresh` sets a per-invocation value so headline "
+            "benches can never be served from the journal result cache "
+            "(the BENCH_r03–r05 stale cache echo); empty (default) keeps "
+            "fingerprints stable across runs."),
     _K("CYLON_TPU_DURABLE_DIR", "str", "", RUNTIME,
        accessors=("cylon_tpu.durable.durable_dir",
                   "cylon_tpu.durable.enabled"),

@@ -185,6 +185,13 @@ def run_fingerprint(op: str, spec, frames: Sequence[Tuple[Sequence[str],
     this agrees."""
     h = hashlib.sha256()
     h.update(f"cylon_tpu.durable.v1|{op}".encode())
+    # opaque salt (CYLON_TPU_FP_SALT): `bench.py --fresh` sets a
+    # per-invocation value so a headline bench can never be served from
+    # the journal result cache (the BENCH_r03–r05 stale cache echo);
+    # empty keeps fingerprints stable across runs
+    salt = config.knob("CYLON_TPU_FP_SALT")
+    if salt:
+        h.update(f"|salt:{salt}".encode())
     _update_spec(h, spec)
     # trace-scope knobs change the traced computation, hence the results
     # a resumed run must match; raw values, like the jit-plan cache keys

@@ -110,6 +110,55 @@ def _targets_and_counts(t, key_idx: Tuple[int, ...], mode: str,
                       out_specs=(P(PARTITION_AXIS), P()))(t)
 
 
+def _targets_counts_stats(t, key_idx: Tuple[int, ...], mode: str,
+                          opts: SortOptions | None):
+    """The compression pre-pass: ONE program returning (sharded targets,
+    replicated count matrix, replicated per-column value stats).  The
+    stats ride the pass that already touches every key (the count-matrix
+    pass), reduced with allreduce collectives so every process derives
+    the identical compression spec from them (plane.build_spec)."""
+    from jax.sharding import PartitionSpec as P
+
+    world = t.num_shards
+    ctx = t.ctx
+    n_stats = partition_mod.stats_arity(t.columns)
+
+    def fn(tt):
+        tgt = _targets(tt, key_idx, world, mode, opts)
+        counts = shuffle_mod.target_counts(tgt, world)
+        cm = collectives.allgather(counts, axis=0).reshape(world, world)
+        stats = partition_mod.column_stats(tt.columns, tt.row_counts[0])
+        return tgt, cm, stats
+
+    return _shard_map(ctx, fn, ("targets+counts+stats", key_idx, mode, opts),
+                      _shapes_key(t),
+                      out_specs=(P(PARTITION_AXIS), P(),
+                                 tuple(P() for _ in range(n_stats))))(t)
+
+
+def _counts_stats_for(t, key_idx: Tuple[int, ...], mode: str,
+                      opts: SortOptions | None):
+    """Bucketed-path compression pre-pass: replicated (count matrix,
+    stats) — _counts_for plus the observation, with NO sharded targets
+    output (the bucketed exchange recomputes targets inside its own
+    program, so materializing them here would be pure waste)."""
+    from jax.sharding import PartitionSpec as P
+
+    world = t.num_shards
+    ctx = t.ctx
+    n_stats = partition_mod.stats_arity(t.columns)
+
+    def fn(tt):
+        tgt = _targets(tt, key_idx, world, mode, opts)
+        counts = shuffle_mod.target_counts(tgt, world)
+        cm = collectives.allgather(counts, axis=0).reshape(world, world)
+        return cm, partition_mod.column_stats(tt.columns, tt.row_counts[0])
+
+    return _shard_map(ctx, fn, ("counts+stats", key_idx, mode, opts),
+                      _shapes_key(t),
+                      out_specs=(P(), tuple(P() for _ in range(n_stats))))(t)
+
+
 def _targets(tt, key_idx, world, mode, opts: SortOptions | None):
     # the span fires at TRACE time (this runs under shard_map tracing):
     # it nests the partition phase under the enclosing plan/exchange span
@@ -182,12 +231,12 @@ def _ragged_enabled(ctx) -> bool:
     return cache["ragged"]
 
 
-def _row_bytes(cols, packed: bool) -> int:
+def _row_bytes(cols, packed: bool, spec=None) -> int:
     """Exchanged bytes per row under either realization — plane words when
-    packed, data+validity+lengths buffer bytes per-buffer (all static
-    shape/dtype metadata, host-side)."""
+    packed (compressed plane words under ``spec``), data+validity+lengths
+    buffer bytes per-buffer (all static shape/dtype metadata, host-side)."""
     if packed:
-        return plane_mod.plane_words(cols) * 4
+        return plane_mod.plane_words(cols, spec) * 4
     total = 0
     for c in cols:
         total += c.data.dtype.itemsize * int(
@@ -198,21 +247,32 @@ def _row_bytes(cols, packed: bool) -> int:
 
 
 def _record_exchange(cols, packed: bool, family: str,
-                     rows_exchanged: int) -> None:
+                     rows_exchanged: int, spec=None) -> None:
     """Account one collective exchange that actually ran: data-collective
     launch count (1 packed vs one per buffer — the PR-3 budget goldens'
     1-vs-13 on the canonical 6-column frame), the counts all_gather, and
-    global bytes moved."""
+    global bytes moved.  Under a compression spec, ``shuffle.bytes_sent``
+    records the bytes that really traveled; the uncompressed-minus-sent
+    delta lands in ``shuffle.bytes_saved`` and the per-exchange ratio in
+    the ``shuffle.compress_ratio`` gauge."""
     launches = 1 if packed else shuffle_mod.buffer_count(cols)
-    bytes_sent = rows_exchanged * _row_bytes(cols, packed)
+    bytes_sent = rows_exchanged * _row_bytes(cols, packed, spec)
     obs_metrics.counter_add("shuffle.exchanges")
     obs_metrics.counter_add("shuffle.collective_launches", launches)
     obs_metrics.counter_add("shuffle.counts_gathers")
     obs_metrics.counter_add("shuffle.bytes_sent", bytes_sent)
+    if spec is not None:
+        raw_bytes = rows_exchanged * _row_bytes(cols, packed)
+        obs_metrics.counter_add("shuffle.bytes_saved",
+                                max(0, raw_bytes - bytes_sent))
+        if bytes_sent > 0:
+            obs_metrics.gauge_set("shuffle.compress_ratio",
+                                  raw_bytes / bytes_sent)
     # distribution, not just the total: one hot exchange in a hundred
     # small ones is invisible in the counter but not in the histogram
     obs_metrics.hist_observe("shuffle.bytes_per_exchange", bytes_sent)
     obs_spans.instant("shuffle.exchange_done", family=family, packed=packed,
+                      compressed=spec is not None,
                       collective_launches=launches, rows=rows_exchanged)
 
 
@@ -242,6 +302,13 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
         # plan cache — flipping CYLON_TPU_SHUFFLE_PACK can never serve a
         # program traced under the other realization
         pack = plane_mod.pack_enabled()
+        # compression rides the packed plane: the pre-pass additionally
+        # observes per-column value stats (replicated via allreduce) and
+        # the host folds them into the static spec.  The spec is realized
+        # -data-derived jit layout, so it rides the exchange plan cache
+        # key below (cylint CY109) — a data change retraces, never
+        # decodes under a stale layout.
+        compress = pack and plane_mod.compress_enabled()
         if _ragged_enabled(ctx):
             with obs_spans.span("shuffle.plan", mode=mode, world=world,
                       family="ragged"):
@@ -249,45 +316,67 @@ def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
                 # path also calls plan_shuffle, so the injection site
                 # lives with the recovery wrapper, not the sizing math
                 resilience.fault_point("shuffle_plan")
-                targets, counts = _targets_and_counts(t, key_idx, mode, opts)
+                spec = None
+                if compress:
+                    targets, counts, stats = _targets_counts_stats(
+                        t, key_idx, mode, opts)
+                    spec = plane_mod.build_spec(
+                        t.columns, [np.asarray(s) for s in stats], world,
+                        t.shard_capacity)
+                else:
+                    targets, counts = _targets_and_counts(t, key_idx, mode,
+                                                          opts)
                 cm = np.asarray(counts).reshape(world, world)
                 _, out_cap = shuffle_mod.plan_shuffle(cm)
 
             def rfn(tt, tgt):
                 cols, total = shuffle_mod.shuffle_shard_ragged(
-                    tt.columns, tgt, world, out_cap)
+                    tt.columns, tgt, world, out_cap, spec=spec)
                 return Table(cols, jnp.reshape(total, (1,)), names, ctx)
 
             with obs_spans.span("shuffle.exchange", packed=pack, family="ragged",
-                      world=world):
+                      world=world, compressed=spec is not None):
                 out = _shard_map(ctx, rfn,
-                                 ("shuffle-ragged", key_idx, out_cap, pack),
+                                 ("shuffle-ragged", key_idx, out_cap, pack,
+                                  spec),
                                  _shapes_key(t))(t, targets)
             # ragged moves exactly the rows that exist
-            _record_exchange(t.columns, pack, "ragged", int(cm.sum()))
+            _record_exchange(t.columns, pack, "ragged", int(cm.sum()),
+                             spec=spec)
             return out
 
         with obs_spans.span("shuffle.plan", mode=mode, world=world, family="bucketed"):
             resilience.fault_point("shuffle_plan")
-            counts = _counts_for(t, key_idx, mode, opts)
+            spec = None
+            if compress:
+                counts, stats = _counts_stats_for(t, key_idx, mode, opts)
+                spec = plane_mod.build_spec(
+                    t.columns, [np.asarray(s) for s in stats], world,
+                    t.shard_capacity)
+            else:
+                counts = _counts_for(t, key_idx, mode, opts)
             bucket, out_cap = shuffle_mod.plan_shuffle(
                 np.asarray(counts).reshape(world, world))
 
-        def fn(tt):
+        # unique closure name: cylint resolves closures module-wide by
+        # bare name, and CY109 must see THIS body's spec use, not some
+        # other `fn`'s
+        def bfn(tt):
             tgt = _targets(tt, key_idx, world, mode, opts)
             cols, total = shuffle_mod.shuffle_shard(
-                tt.columns, tt.row_counts[0], tgt, world, bucket, out_cap)
+                tt.columns, tt.row_counts[0], tgt, world, bucket, out_cap,
+                spec=spec)
             return Table(cols, jnp.reshape(total, (1,)), names, ctx)
 
         with obs_spans.span("shuffle.exchange", packed=pack, family="bucketed",
-                  world=world, bucket=bucket):
-            out = _shard_map(ctx, fn,
+                  world=world, bucket=bucket, compressed=spec is not None):
+            out = _shard_map(ctx, bfn,
                              ("shuffle", key_idx, mode, opts, bucket,
-                              out_cap, pack),
+                              out_cap, pack, spec),
                              _shapes_key(t))(t)
         # every (src, dst) pair pads to the static bucket
         _record_exchange(t.columns, pack, "bucketed",
-                         world * world * bucket)
+                         world * world * bucket, spec=spec)
         return out
 
     out, _attempts = resilience.retry_call(

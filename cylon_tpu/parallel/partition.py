@@ -14,10 +14,16 @@ TPU-native replacement for the reference's partition layer
 
 Both run *inside* shard_map: each shard computes targets for its own rows.
 Padding rows get target ``world`` (a sentinel bucket nothing is sent to).
+
+``column_stats`` rides the same pre-pass (the count-matrix program that
+already touches every key): it observes each column's realized value
+range / string extent / cardinality and reduces them to REPLICATED
+scalars with allreduce collectives, so every process derives the same
+compression spec (``plane.build_spec``) for the exchange that follows.
 """
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +34,7 @@ from ..ops import compact as compact_mod
 from ..ops import hashing
 from ..ops import pallas_kernels
 from . import collectives
+from . import plane as plane_mod
 
 
 def hash_targets(cols: Sequence[Column], count, key_idx: Sequence[int],
@@ -133,3 +140,68 @@ def range_targets(col: Column, count, world: int, *, num_bins: int,
     t = jnp.where(col.validity, t, null_target)
     row_live = compact_mod.live_mask(cap, count)
     return jnp.where(row_live, t, jnp.int32(world))
+
+
+# ---------------------------------------------------------------------------
+# compression observation pass (PR 10)
+# ---------------------------------------------------------------------------
+
+
+def stats_arity(cols: Sequence[Column]) -> int:
+    """How many replicated stat arrays column_stats returns — the host
+    side sizes its out_specs / unpacking from the same layout walk."""
+    lay = plane_mod.stats_layout(cols)
+    return sum(2 if k == "int" else 3 if k == "str" else 0 for k in lay)
+
+
+def column_stats(cols: Sequence[Column], count) -> Tuple[jax.Array, ...]:
+    """Observed-value stats of every LIVE row, replicated across the mesh
+    (runs inside shard_map; allreduce collectives make every shard — and
+    every process — see identical values).  Flat tuple matching
+    ``plane.stats_layout``: (min, max) per integer column; (nonzero byte
+    extent, max length, max per-shard distinct count) per string column.
+
+    Liveness is ``row < count``, NOT validity: null rows' raw payload
+    bits travel through the exchange and must stay inside the observed
+    range, while padding rows beyond the count are never sent and may
+    fall outside it."""
+    cap = cols[0].data.shape[0]
+    live = compact_mod.live_mask(cap, count)
+    out: List[jax.Array] = []
+    for c, kind in zip(cols, plane_mod.stats_layout(cols)):
+        if kind == "int":
+            info = jnp.iinfo(c.data.dtype)
+            big = jnp.asarray(info.max, c.data.dtype)
+            small = jnp.asarray(info.min, c.data.dtype)
+            mn = collectives.allreduce_min(
+                jnp.min(jnp.where(live, c.data, big)))
+            mx = collectives.allreduce_max(
+                jnp.max(jnp.where(live, c.data, small)))
+            out.append(jnp.reshape(mn, (1,)))
+            out.append(jnp.reshape(mx, (1,)))
+        elif kind == "str":
+            w = c.string_width
+            if w:
+                nzcol = jnp.any((c.data != 0) & live[:, None], axis=0)
+                extent = jnp.max(jnp.where(
+                    nzcol, jnp.arange(1, w + 1, dtype=jnp.int32), 0))
+            else:
+                extent = jnp.int32(0)
+            maxlen = jnp.max(jnp.where(live, c.lengths, 0))
+            # distinct (bytes, length) count among live rows, over the
+            # SAME key tuple the codec's local dictionary build walks
+            # (plane.string_key_words — single-sourced, or lcap would
+            # silently under-cover the dictionary); non-live rows
+            # collapse into one sentinel group, so the observed count
+            # stays a safe upper bound for the codec's dictionary
+            # (padding rows are the zero row, present via its reserved
+            # entry)
+            sent = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+            kws = [jnp.where(live, wv, sent)
+                   for wv in plane_mod.string_key_words(c)]
+            _swv, flag = plane_mod.sorted_distinct_flags(kws)
+            nun = jnp.sum(flag, dtype=jnp.int32)
+            out.append(jnp.reshape(collectives.allreduce_max(extent), (1,)))
+            out.append(jnp.reshape(collectives.allreduce_max(maxlen), (1,)))
+            out.append(jnp.reshape(collectives.allreduce_max(nun), (1,)))
+    return tuple(out)

@@ -129,7 +129,7 @@ def _perm_by_target(targets: jax.Array, world: int) -> jax.Array:
 
 
 def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
-                  world: int, bucket: int, out_capacity: int):
+                  world: int, bucket: int, out_capacity: int, spec=None):
     """Shard-local body of the shuffle (run under shard_map).
 
     bucket: static per-(src,dst) bucket row capacity; rows beyond it would be
@@ -142,7 +142,12 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
     plane, ONE ``all_to_all`` total, bucket-lay/compaction gathers run once
     on the plane; per-buffer — one collective and one gather pair per
     buffer.  Both produce bit-identical shards (tests/test_shuffle_pack.py).
-    """
+
+    ``spec`` (packed realization only): the observed compression spec the
+    caller derived from the pre-pass stats — narrow/dictionary/truncated
+    plane fields, bit-exact round trip, at most one extra dictionary
+    all_gather (plane.PlaneCodec).  Data-dependent static layout: callers
+    key their jit-plan caches on it (cylint CY109)."""
     cap = cols[0].data.shape[0]
 
     counts = target_counts(targets, world)
@@ -182,10 +187,13 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
     if plane_mod.pack_enabled():
         # ONE collective for the whole table: pack at shard capacity,
         # bucket-lay the plane (single gather), exchange, compact (single
-        # gather), decode with the tail mask
+        # gather), decode with the tail mask.  The codec applies the
+        # compression spec (identity when spec is None); dictionary
+        # columns cost one extra small all_gather at codec build.
+        codec = plane_mod.PlaneCodec(cols, spec)
         with obs_spans.span("shuffle.pack", columns=len(cols)) as sp:
-            packed = plane_mod.pack_plane(cols)
-            sp.set(words=int(packed.shape[1]))
+            packed = codec.pack(cols)
+            sp.set(words=int(packed.shape[1]), compressed=spec is not None)
             send_plane = jnp.where(send_valid[:, None],
                                    jnp.take(packed, src, axis=0), 0)
         with obs_spans.span("shuffle.collective", family="all_to_all",
@@ -193,7 +201,7 @@ def shuffle_shard(cols: Tuple[Column, ...], count, targets: jax.Array,
             recv_plane = collectives.all_to_all(send_plane)
         with obs_spans.span("shuffle.unpack", columns=len(cols)):
             out_plane = jnp.take(recv_plane, src2, axis=0)
-            out = plane_mod.unpack_plane(out_plane, cols, valid_mask=valid2)
+            out = codec.unpack(out_plane, cols, valid_mask=valid2)
         return out, total
 
     # per-buffer exchange: one tiled all_to_all per buffer
@@ -245,7 +253,7 @@ def ragged_plan(cm, me):
 
 
 def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
-                         world: int, out_capacity: int):
+                         world: int, out_capacity: int, spec=None):
     """Skew-proof shard-local shuffle body over ``lax.ragged_all_to_all``.
 
     Where ``shuffle_shard`` pads every (src,dst) pair to one static bucket
@@ -282,9 +290,10 @@ def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
     recv_sizes, output_offsets, total = ragged_plan(cm, me)
 
     if plane_mod.pack_enabled():
+        codec = plane_mod.PlaneCodec(cols, spec)
         with obs_spans.span("shuffle.pack", columns=len(cols)) as sp:
-            packed = plane_mod.pack_plane(cols)
-            sp.set(words=int(packed.shape[1]))
+            packed = codec.pack(cols)
+            sp.set(words=int(packed.shape[1]), compressed=spec is not None)
             sorted_plane = jnp.take(packed, perm_t, axis=0)
         with obs_spans.span("shuffle.collective",
                             family="ragged_all_to_all", packed=True,
@@ -293,13 +302,19 @@ def shuffle_shard_ragged(cols: Tuple[Column, ...], targets: jax.Array,
             got = collectives.ragged_all_to_all(
                 sorted_plane, out, input_offsets, counts, output_offsets,
                 recv_sizes)
-        # NO mask on decode: the per-buffer path below moves raw buffers
-        # (a null row's bytes pass through untouched), and the plane must
-        # stay bit-identical to it; rows past ``total`` decode from the
-        # zeros of ``out`` — validity False, zero data — exactly like the
-        # unwritten tail of the per-buffer outputs
+        # NO validity mask on decode: the per-buffer path below moves raw
+        # buffers (a null row's bytes pass through untouched), and the
+        # plane must stay bit-identical to it; rows past ``total`` decode
+        # from the zeros of ``out`` — validity False, zero data — exactly
+        # like the unwritten tail of the per-buffer outputs.  Under a
+        # compression spec zero fields no longer decode to zero VALUES
+        # (offset / dictionary entry 0), so the tail is masked explicitly
+        # — in-range null rows' raw payloads stay untouched.
         with obs_spans.span("shuffle.unpack", columns=len(cols)):
-            out_cols = plane_mod.unpack_plane(got, cols)
+            tail = None
+            if spec is not None:
+                tail = jnp.arange(out_capacity, dtype=jnp.int32) < total
+            out_cols = codec.unpack(got, cols, tail_mask=tail)
         return out_cols, total
 
     def exchange(buf):

@@ -42,11 +42,19 @@ def _render(plan, p: optimizer.Phys, lines: List[str], depth: int) -> None:
     if isinstance(n, ir.Scan):
         t = plan.inputs[n.idx]
         note = ""
-        if len(p.keep) < len(n.names):
-            ann = optimizer.plane_annotation(t, p.keep)
+        pruned = len(p.keep) < len(n.names)
+        ann = optimizer.plane_annotation(t, p.keep)
+        comp = ann.get("words_comp")
+        if pruned or (comp is not None and comp < ann["words_pruned"]):
+            # pruning and compression attribute separately: full->pruned
+            # words are the planner's column elimination, pruned->comp
+            # the payload encoder's bit-width/dictionary win
+            words = f"plane {ann['words_full']}->{ann['words_pruned']}"
+            if comp is not None:
+                words += f"->{comp}"
             note = (f"  [pruned {len(n.names)}->{len(p.keep)} cols, "
-                    f"plane {ann['words_full']}->{ann['words_pruned']} "
-                    f"words/row]")
+                    f"{words} words/row"
+                    + (" (compressed)" if comp is not None else "") + "]")
         lines.append(f"{pad}scan {n.label}: {', '.join(p.keep)}{note}")
         return
     if isinstance(n, ir.Project):

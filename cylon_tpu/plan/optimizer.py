@@ -485,14 +485,35 @@ def _rule_fuse_local(p: Phys, world: int, out: PhysPlan) -> None:
 def plane_annotation(table, keep: Tuple[str, ...]) -> Dict[str, int]:
     """Packed-plane word width of the full vs pruned column set — the
     explain() annotation making the pruning win concrete in bytes.
-    Consults the trace-scope pack knob (the realization the exchange
-    would actually use); the plan FINGERPRINT covers every trace knob
-    via durable.run_fingerprint, which cylint CY108 machine-checks."""
+    Consults the trace-scope pack/compress knobs (the realization the
+    exchange would actually use); the plan FINGERPRINT covers every
+    trace knob via durable.run_fingerprint, which cylint CY108
+    machine-checks.
+
+    When compression is active, ``words_comp`` additionally reports the
+    pruned set's width under the host-ESTIMATED compression spec
+    (plane.estimate_spec over addressable buffers — advisory, like the
+    rest of explain), so pruning and compression savings attribute
+    separately: full -> pruned is the planner's win, pruned -> comp the
+    payload encoder's."""
     cols = list(table.columns)
     kept = [c for n, c in zip(table.names, cols) if n in set(keep)]
     packed = plane_mod.pack_enabled()
-    return {
+    comp = packed and plane_mod.compress_enabled()
+    ann = {
         "words_full": plane_mod.plane_words(cols) if cols else 0,
         "words_pruned": plane_mod.plane_words(kept) if kept else 0,
         "packed": int(packed),
+        "compressed": int(comp),
     }
+    # estimate_spec realizes buffers on the host (np.asarray) — fine for
+    # an advisory explain() on a single-controller mesh, but an array
+    # spanning non-addressable devices would raise, so the annotation is
+    # simply omitted there (the REAL exchange derives its spec from the
+    # replicated device stats pass, never from this estimate)
+    if comp and kept and all(
+            getattr(c.data, "is_fully_addressable", True) for c in kept):
+        spec = plane_mod.estimate_spec(kept, world=table.num_shards,
+                                       shard_cap=table.shard_capacity)
+        ann["words_comp"] = plane_mod.plane_words(kept, spec)
+    return ann

@@ -122,3 +122,48 @@ def test_bench_emits_one_valid_artifact_line():
         assert key in art, art
     assert art["value"] > 0
     assert "rows/sec" in art["unit"]
+
+
+def test_fresh_disables_seeding_and_salts_fingerprint(tmp_path, monkeypatch):
+    """--fresh (ISSUE-10): the artifact can never be the cached seed —
+    seeding is disabled, the durable fingerprint is salted per
+    invocation (so journal replays of an older run miss), and live
+    artifacts stamp cache_served: false."""
+    import time as _time
+
+    bench = _load_bench_module()
+    bench.FRESH = True
+    monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache.json"))
+    with open(bench.CACHE_PATH, "w") as f:
+        json.dump({"tpu": {"value": 5.3e6, "rows": 1 << 23,
+                           "backend": "tpu",
+                           "measured_at": _time.strftime("%Y-%m-%d"),
+                           "fingerprint": bench._code_fingerprint()},
+                   "pandas": {}}, f)
+    # seeding path honors CYLON_BENCH_SEED_CACHE=0 (main() sets it under
+    # --fresh before constructing _Bench)
+    monkeypatch.setenv("CYLON_BENCH_SEED_CACHE", "0")
+    b = bench._Bench(60.0)
+    assert b.result is None  # the seed was refused
+    # a live artifact under --fresh carries the machine-readable stamp
+    b.accept({"value": 1.0e6, "rows": 1 << 22, "backend": "cpu"})
+    assert b.result["cache_served"] is False
+    assert b.result["fresh"] is True
+
+
+def test_fresh_salt_changes_durable_fingerprint(monkeypatch):
+    """CYLON_TPU_FP_SALT must perturb run_fingerprint — the journal
+    result cache keys on it, so a salted bench can never be served a
+    prior run's spill."""
+    import numpy as np
+
+    from cylon_tpu import config, durable
+
+    frames = [(("k",), {"k": np.arange(8)})]
+    with config.knob_env(CYLON_TPU_FP_SALT=None):
+        base = durable.run_fingerprint("join", ("on", "k"), frames)
+        again = durable.run_fingerprint("join", ("on", "k"), frames)
+    with config.knob_env(CYLON_TPU_FP_SALT="fresh-123"):
+        salted = durable.run_fingerprint("join", ("on", "k"), frames)
+    assert base == again
+    assert salted != base

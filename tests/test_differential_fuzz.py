@@ -194,3 +194,75 @@ def test_hash_algorithm_join_differential(ctx4, seed):
     np.testing.assert_allclose(
         np.sort(np.nan_to_num(got["r_v"].to_numpy(), nan=-7e9)),
         np.sort(np.nan_to_num(g["v_r"].to_numpy(), nan=-7e9)), rtol=1e-12)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:6])
+def test_join_differential_compressed(ctx4, seed, monkeypatch):
+    """ISSUE-10: the compressed packed exchange under the same random
+    nulls/skew/negative-key grid must still agree with pandas (and so
+    with the uncompressed arms the other suites pin bit-identical)."""
+    monkeypatch.setenv("CYLON_TPU_SHUFFLE_PACK", "1")
+    monkeypatch.setenv("CYLON_TPU_SHUFFLE_COMPRESS", "1")
+    rng = np.random.default_rng(1000 + seed)  # the same grid as the
+    how = ["inner", "left", "right", "outer"][seed % 4]  # uncompressed run
+    ldf, rdf = _rand_frame(rng), _rand_frame(rng)
+    t = _mk(ldf, ctx4).distributed_join(_mk(rdf, ctx4), on="k", how=how)
+    g = ldf.merge(rdf, on="k", how=how, suffixes=("_l", "_r"))
+    got = t.to_pandas()
+    assert len(got) == len(g)
+    np.testing.assert_allclose(
+        np.sort(np.nan_to_num(got["l_v"].to_numpy(), nan=-7e9)),
+        np.sort(np.nan_to_num(g["v_l"].to_numpy(), nan=-7e9)), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.sort(np.nan_to_num(got["r_v"].to_numpy(), nan=-7e9)),
+        np.sort(np.nan_to_num(g["v_r"].to_numpy(), nan=-7e9)), rtol=1e-12)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:6])
+def test_groupby_differential_compressed(ctx4, seed, monkeypatch):
+    """Compressed partial-shuffle group-by vs the pandas oracle."""
+    monkeypatch.setenv("CYLON_TPU_SHUFFLE_PACK", "1")
+    monkeypatch.setenv("CYLON_TPU_SHUFFLE_COMPRESS", "1")
+    rng = np.random.default_rng(2000 + seed)
+    df = _rand_frame(rng, allow_empty=False)
+    t = _mk(df, ctx4).groupby("k", {"v": ["sum", "count", "min", "max"]})
+    g = (df.groupby("k")
+         .agg(sum_v=("v", "sum"), count_v=("v", "count"),
+              min_v=("v", "min"), max_v=("v", "max")).reset_index())
+    got = t.to_pandas().sort_values("k").reset_index(drop=True)
+    g = g.sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(got["k"], g["k"])
+    np.testing.assert_array_equal(got["count_v"], g["count_v"])
+    np.testing.assert_allclose(np.nan_to_num(got["sum_v"].to_numpy()),
+                               g["sum_v"], rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(got["min_v"], g["min_v"], rtol=1e-9,
+                               atol=1e-12, equal_nan=True)
+    np.testing.assert_allclose(got["max_v"], g["max_v"], rtol=1e-9,
+                               atol=1e-12, equal_nan=True)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:4])
+def test_string_key_compressed_differential(ctx4, seed, monkeypatch):
+    """Dictionary-encoded string keys through join + group-by."""
+    monkeypatch.setenv("CYLON_TPU_SHUFFLE_PACK", "1")
+    monkeypatch.setenv("CYLON_TPU_SHUFFLE_COMPRESS", "1")
+    rng = np.random.default_rng(6000 + seed)
+    n = int(rng.integers(1, 120))
+    m = int(rng.integers(1, 120))
+    card = int(rng.integers(1, 25))
+    pool = np.array([f"key_{i:03d}" for i in range(card)], object)
+    ldf = pd.DataFrame({"s": pool[rng.integers(0, card, n)],
+                        "v": rng.random(n)})
+    rdf = pd.DataFrame({"s": pool[rng.integers(0, card, m)],
+                        "w": rng.random(m)})
+    t = _mk(ldf, ctx4).distributed_join(_mk(rdf, ctx4), on="s", how="inner")
+    g = ldf.merge(rdf, on="s", how="inner")
+    assert t.row_count == len(g)
+    gb = _mk(ldf, ctx4).groupby("s", {"v": ["sum", "count"]})
+    gg = (ldf.groupby("s").agg(sum_v=("v", "sum"), count_v=("v", "count"))
+          .reset_index())
+    got = gb.to_pandas().sort_values("s").reset_index(drop=True)
+    gg = gg.sort_values("s").reset_index(drop=True)
+    assert list(got["s"]) == list(gg["s"])
+    np.testing.assert_allclose(got["sum_v"], gg["sum_v"], rtol=1e-9)
+    np.testing.assert_array_equal(got["count_v"], gg["count_v"])

@@ -356,3 +356,194 @@ def test_collective_launch_count_ragged(ctx4, monkeypatch, rng):
     monkeypatch.delenv("CYLON_TPU_SHUFFLE_PACK", raising=False)
     assert counts["0"] == 13
     assert counts["1"] == 1
+
+
+# ---------------------------------------------------------------------------
+# compressed payloads (ISSUE-10): bit-width reduction + dictionary codes
+# on the packed plane must stay bit-identical to BOTH uncompressed
+# realizations, and the bytes actually drop
+# ---------------------------------------------------------------------------
+
+
+def _edge_df(n, rng):
+    """The compression edge grid: extreme 64-bit ranges (cannot narrow),
+    negative ranges, a single-value column, an all-null float column,
+    empty strings, and a low-cardinality category column."""
+    cats = np.array(["AA", "B", "CCC"], object)
+    return pd.DataFrame({
+        "k": rng.integers(-20, 20, n).astype(np.int64),
+        "ext": np.where(rng.integers(0, 2, n) == 0,
+                        np.iinfo(np.int64).min,
+                        np.iinfo(np.int64).max).astype(np.int64),
+        "neg": rng.integers(-5000, -4000, n).astype(np.int64),
+        "one": np.full(n, 42, np.int32),
+        "nul": np.full(n, np.nan, np.float64),
+        "empty_s": np.array([""] * n, object),
+        "cat": cats[rng.integers(0, 3, n)],
+        "ts": (rng.integers(0, 1000, n) + 1_600_000_000_000).astype(np.int64),
+    })
+
+
+def _abc_shuffle(monkeypatch, t, keys):
+    """Three-arm A/B/C: per-buffer baseline, packed uncompressed, packed
+    compressed — all three must agree bit-for-bit."""
+    arms = {"perbuf": ("0", "0"), "packed": ("1", "0"), "comp": ("1", "1")}
+    shards = {}
+    for label, (pack, comp) in arms.items():
+        monkeypatch.setenv("CYLON_TPU_SHUFFLE_PACK", pack)
+        monkeypatch.setenv("CYLON_TPU_SHUFFLE_COMPRESS", comp)
+        s = t.shuffle(keys)
+        shards[label] = (s.row_count, _shard_frames(s))
+    monkeypatch.delenv("CYLON_TPU_SHUFFLE_PACK", raising=False)
+    monkeypatch.delenv("CYLON_TPU_SHUFFLE_COMPRESS", raising=False)
+    assert shards["perbuf"][0] == shards["packed"][0] == shards["comp"][0]
+    _assert_shards_equal(shards["perbuf"][1], shards["comp"][1])
+    _assert_shards_equal(shards["packed"][1], shards["comp"][1])
+    return shards["comp"][0]
+
+
+@pytest.mark.parametrize("world_fixture", ["local_ctx", "ctx2", "ctx4"])
+@pytest.mark.parametrize("permute", PERMUTE_MODES)
+def test_compressed_vs_uncompressed_worlds(world_fixture, permute,
+                                           monkeypatch, rng, request):
+    ctx = request.getfixturevalue(world_fixture)
+    monkeypatch.setenv("CYLON_TPU_PERMUTE", permute)
+    n = 1200
+    assert _abc_shuffle(monkeypatch, _table(ctx, _mixed_df(n, rng)),
+                        ["k"]) == n
+
+
+@pytest.mark.parametrize("world_fixture", ["local_ctx", "ctx2", "ctx4"])
+def test_compressed_edge_columns(world_fixture, monkeypatch, rng, request):
+    """INT64_MIN/MAX, negative ranges, single-value, all-null, width-0
+    strings, low-cardinality categories — across worlds 1/2/4."""
+    ctx = request.getfixturevalue(world_fixture)
+    n = 700
+    assert _abc_shuffle(monkeypatch, _table(ctx, _edge_df(n, rng)),
+                        ["k"]) == n
+
+
+def test_compressed_skew_and_empty(ctx4, monkeypatch, rng):
+    df = _mixed_df(900, rng)
+    df["k"] = np.int64(7)  # one hot key
+    assert _abc_shuffle(monkeypatch, _table(ctx4, df), ["k"]) == 900
+    assert _abc_shuffle(monkeypatch, _table(ctx4, _mixed_df(0, rng)),
+                        ["k"]) == 0
+
+
+def test_compressed_launch_count(ctx4, monkeypatch, rng):
+    """The ISSUE-10 budget pin, asserted directly on the jaxpr: the
+    compressed exchange is 1 packed all_to_all + 1 count all_gather +
+    at most 1 dictionary all_gather, independent of column count."""
+    from cylon_tpu.parallel import plane as plane_mod
+
+    world = 4
+    shard_cap = 64
+    n = world * shard_cap
+    df = _mixed_df(n, rng)
+    cols = tuple(colmod.from_numpy(df[c].to_numpy(), capacity=n)
+                 for c in df.columns)
+    targets = jnp.asarray(rng.integers(0, world, n).astype(np.int32))
+    monkeypatch.setenv("CYLON_TPU_SHUFFLE_PACK", "1")
+    monkeypatch.setenv("CYLON_TPU_SHUFFLE_COMPRESS", "1")
+    spec = plane_mod.estimate_spec(cols, world=world, shard_cap=shard_cap)
+    assert spec is not None
+    assert any(e[0] == "dict" for e in spec)  # the string column encodes
+
+    def fn(cc, tgt):
+        out_cols, total = shuffle_mod.shuffle_shard(
+            cc, None, tgt, world, shard_cap, n, spec=spec)
+        return out_cols, jnp.reshape(total, (1,))
+
+    from jax.sharding import PartitionSpec as P
+
+    from cylon_tpu.context import PARTITION_AXIS
+    from cylon_tpu.utils import shard_map
+
+    ctx = ctx4
+    f = jax.jit(shard_map(fn, mesh=ctx.mesh, in_specs=P(PARTITION_AXIS),
+                          out_specs=P(PARTITION_AXIS), check_vma=False))
+    jaxpr = jax.make_jaxpr(f)(cols, targets)
+    monkeypatch.delenv("CYLON_TPU_SHUFFLE_PACK", raising=False)
+    monkeypatch.delenv("CYLON_TPU_SHUFFLE_COMPRESS", raising=False)
+    assert _count_prims(jaxpr.jaxpr, _EXCHANGE_PRIMS) == 1
+    assert _count_prims(jaxpr.jaxpr, _COUNT_PRIMS) <= 2
+
+
+def test_compressed_bytes_drop_low_cardinality(ctx4, monkeypatch, rng):
+    """The acceptance meter: >= 1.5x shuffle.bytes_sent drop on the
+    goldened low-cardinality workload (narrow int keys + category
+    strings), with bit-identical shards asserted by the arms above."""
+    from cylon_tpu.obs import metrics as obs_metrics
+
+    n = 2000
+    cats = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE"], object)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 100, n).astype(np.int64),
+        "seg": cats[rng.integers(0, 3, n)],
+        "date": rng.integers(0, 2556, n).astype(np.int32),
+        "price": rng.random(n).astype(np.float32),
+    })
+    t = _table(ctx4, df)
+    sent = {}
+    for label, comp in (("plain", "0"), ("comp", "1")):
+        monkeypatch.setenv("CYLON_TPU_SHUFFLE_PACK", "1")
+        monkeypatch.setenv("CYLON_TPU_SHUFFLE_COMPRESS", comp)
+        before = obs_metrics.counter_value("shuffle.bytes_sent")
+        t.shuffle(["k"])
+        sent[label] = obs_metrics.counter_value("shuffle.bytes_sent") - before
+    monkeypatch.delenv("CYLON_TPU_SHUFFLE_PACK", raising=False)
+    monkeypatch.delenv("CYLON_TPU_SHUFFLE_COMPRESS", raising=False)
+    assert sent["comp"] > 0
+    assert sent["plain"] / sent["comp"] >= 1.5, sent
+    assert obs_metrics.counter_value("shuffle.bytes_saved") > 0
+
+
+def test_build_spec_units(rng):
+    """Host-side spec math: narrowing, raw fallbacks, dictionary vs
+    truncation selection."""
+    from cylon_tpu.parallel import plane as plane_mod
+
+    n = 64
+    cols = (
+        colmod.from_numpy(rng.integers(100, 300, n).astype(np.int64)),
+        colmod.from_numpy(np.array([np.iinfo(np.int64).min,
+                                    np.iinfo(np.int64).max] * 32,
+                                   np.int64)),
+        colmod.from_numpy(np.full(n, -9, np.int64)),
+        colmod.from_numpy(rng.random(n).astype(np.float32)),
+        colmod.from_numpy(np.array(["x", "yy"], object)[
+            rng.integers(0, 2, n)]),
+    )
+    spec = plane_mod.estimate_spec(cols, world=4, shard_cap=n)
+    assert spec[0][0] == "narrow" and spec[0][2] <= 12   # range 200
+    assert spec[1] == ("raw",)                           # full i64 span
+    assert spec[2][0] == "narrow" and spec[2][1] == -9 and spec[2][2] == 0
+    assert spec[3] == ("raw",)                           # float: raw bits
+    assert spec[4][0] == "dict"                          # 2 distinct values
+    # all-raw normalizes to None so baseline programs are reused
+    raw_cols = (colmod.from_numpy(np.array(
+        [np.iinfo(np.int64).min, np.iinfo(np.int64).max] * 32, np.int64)),)
+    assert plane_mod.estimate_spec(raw_cols, world=4, shard_cap=n) is None
+
+
+def test_plane_roundtrip_with_spec(rng):
+    """Narrow + truncated encodings round-trip bit-exactly without any
+    collective (the dictionary arm is exercised by the shuffle tests)."""
+    from cylon_tpu.parallel import plane as plane_mod
+
+    n = 64
+    cols = (
+        colmod.from_numpy(rng.integers(-50, 1000, n).astype(np.int64)),
+        colmod.from_numpy(rng.integers(0, 7, n).astype(np.int16)),
+        colmod.from_numpy(np.array(["ab", "", "c"], object)[
+            rng.integers(0, 3, n)]),
+    )
+    spec = plane_mod.estimate_spec(cols, world=4, shard_cap=n)
+    # force the string column onto the truncation arm (dict needs the
+    # gather collective)
+    spec = tuple(("trunc", e[1], 8) if e[0] == "dict" else e for e in spec)
+    assert plane_mod.plane_words(cols, spec) < plane_mod.plane_words(cols)
+    out = plane_mod.unpack_plane(plane_mod.pack_plane(cols, spec), cols,
+                                 spec=spec)
+    _assert_cols_equal(cols, out, "spec-roundtrip")

@@ -428,6 +428,62 @@ def test_cy108_only_fires_under_the_plan_package(tmp_path):
     assert "CY108" not in {f.rule for f in found}
 
 
+_CY109_BUILDER = """\
+    import jax
+    from cylon_tpu import config
+    from cylon_tpu.parallel import plane
+
+    def my_builder(ctx, fn, key, shapes_key):
+        cache = {}
+        entry = jax.jit(fn)
+        cache[(key, shapes_key, config.trace_cache_token())] = entry
+        return entry
+"""
+
+
+def test_cy109_realized_layout_missing_from_key(tmp_path):
+    # the ISSUE-10 bug class: an observed (data-derived) compression
+    # spec baked into a traced body while the plan cache key omits it —
+    # a data change would decode under the stale field layout.  The
+    # builder is trace_cache_token-complete, which must NOT exempt it
+    # (the token covers knobs, not data).
+    found = _scan(tmp_path, _CY109_BUILDER + """\
+
+    def bad(ctx, t, stats):
+        spec = plane.build_spec(t.columns, stats, 4, 64)
+        def body(tt):
+            return plane.pack_plane(tt.columns, spec)
+        return my_builder(ctx, body, ("shuffle", 4), ())
+    """)
+    hits = [(f.rule, f.line) for f in found if f.rule == "CY109"]
+    assert hits == [("CY109", 15)], _rules_at(found)
+    assert "spec" in found[0].msg and "stale field layout" in found[0].msg
+
+
+def test_cy109_spec_in_key_is_clean(tmp_path):
+    found = _scan(tmp_path, _CY109_BUILDER + """\
+
+    def good(ctx, t, stats):
+        spec = plane.estimate_spec(t.columns, 4, 64)
+        def body(tt):
+            return plane.pack_plane(tt.columns, spec)
+        return my_builder(ctx, body, ("shuffle", 4, spec), ())
+    """)
+    assert "CY109" not in {f.rule for f in found}, _rules_at(found)
+
+
+def test_cy109_no_realized_values_is_clean(tmp_path):
+    # closures that never touch a realized-layout value are out of scope
+    found = _scan(tmp_path, _CY109_BUILDER + """\
+
+    def plain(ctx, t):
+        def body(tt):
+            return plane.pack_plane(tt.columns)
+        return my_builder(ctx, body, ("shuffle",), ())
+    """)
+    assert "CY109" not in {f.rule for f in found}, _rules_at(found)
+
+
 def test_cy001_suppression_requires_justification(tmp_path):
     # no justification: the suppression itself is the finding (and does
     # not silence the underlying rule)

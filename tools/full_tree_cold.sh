@@ -360,6 +360,86 @@ if [ $rc -ne 0 ]; then
   echo "planner smoke failed (rc=$rc); fix the query planner before the full tree" >&2
   exit $rc
 fi
+# compression smoke (ISSUE-10): a low-cardinality TPC-H Q3 lineitem
+# shuffle with CYLON_TPU_SHUFFLE_COMPRESS on vs off must drop
+# shuffle.bytes_sent by >1.5x while the shards stay bit-identical —
+# asserted from the artifact JSON, catches a payload-encoder regression
+# in ~1 min, before the full tree runs
+CS=$(mktemp -d /tmp/cylon_compress_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - "$CS" <<'PYEOF'
+import json, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cylon_tpu import Table, config
+from cylon_tpu.context import CylonContext, TPUConfig
+from cylon_tpu.obs import metrics
+
+ctx = CylonContext.InitDistributed(TPUConfig(world_size=4))
+rng = np.random.default_rng(0)
+from examples import tpch_data
+raw_o = tpch_data.orders(0.004, rng, q3_cols=True)
+raw_l = tpch_data.lineitem(0.004, rng, q5_keys=True,
+                           orders_rows=len(raw_o["o_orderkey"]))
+raw_l.pop("l_suppkey", None)
+line = Table.from_numpy(list(raw_l), list(raw_l.values()), ctx=ctx)
+
+def shards(t):
+    out = []
+    for sid, cols, cnt in t._addressable_host_shards():
+        out.append((sid, cnt, [(np.asarray(c.data)[:cnt],
+                                np.asarray(c.validity)[:cnt],
+                                None if c.lengths is None
+                                else np.asarray(c.lengths)[:cnt])
+                               for c in cols]))
+    return out
+
+res = {}
+for label, mode in (("plain", "0"), ("compressed", "1")):
+    with config.knob_env(CYLON_TPU_SHUFFLE_PACK="1",
+                         CYLON_TPU_SHUFFLE_COMPRESS=mode):
+        before = metrics.counter_value("shuffle.bytes_sent")
+        s = line.shuffle(["l_orderkey"])
+        sent = metrics.counter_value("shuffle.bytes_sent") - before
+        res[label] = (s.row_count, shards(s), sent)
+assert res["plain"][0] == res["compressed"][0]
+for (s0, c0, f0), (s1, c1, f1) in zip(res["plain"][1], res["compressed"][1]):
+    assert s0 == s1 and c0 == c1
+    for b0, b1 in zip(f0, f1):
+        for x, y in zip(b0, b1):
+            if x is None:
+                assert y is None
+            else:
+                np.testing.assert_array_equal(x, y)
+rec = {"rows": int(res["plain"][0]),
+       "bytes_plain": int(res["plain"][2]),
+       "bytes_compressed": int(res["compressed"][2]),
+       "ratio": res["plain"][2] / max(1, res["compressed"][2]),
+       "bytes_saved": int(metrics.counter_value("shuffle.bytes_saved"))}
+with open(f"{sys.argv[1]}/compress_smoke.json", "w") as fh:
+    json.dump(rec, fh, indent=1, sort_keys=True)
+PYEOF
+rc=$?
+if [ $rc -eq 0 ]; then
+  python - "$CS" <<'PYEOF'
+import json, sys
+rec = json.load(open(f"{sys.argv[1]}/compress_smoke.json"))
+assert rec["ratio"] > 1.5, rec
+assert rec["bytes_saved"] > 0, rec
+print(f"compression smoke ok: {rec['bytes_plain']} -> "
+      f"{rec['bytes_compressed']} bytes sent ({rec['ratio']:.2f}x) on a "
+      f"{rec['rows']}-row low-cardinality Q3 lineitem shuffle, "
+      f"bit-identical shards")
+PYEOF
+  rc=$?
+fi
+rm -rf "$CS"
+if [ $rc -ne 0 ]; then
+  echo "compression smoke failed (rc=$rc); fix the payload encoder before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

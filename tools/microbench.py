@@ -96,10 +96,81 @@ def _plan_ab(n_rows: int) -> bool:
     return True
 
 
+def _compress_ab(n_rows: int) -> bool:
+    """ISSUE-10 A/B arm: one low-cardinality shuffle (narrow int keys +
+    dictionary-friendly category strings, the TPC-H Q3 lineitem shape)
+    with CYLON_TPU_SHUFFLE_COMPRESS off vs on, packed plane both arms.
+    Reports bytes_sent, plane words/row, and wall time per arm — the
+    compressed exchange must move the same rows in fewer bits while the
+    shards stay bit-identical (tests pin that; this arm measures it)."""
+    from cylon_tpu import Table, config
+    from cylon_tpu.context import CylonContext, TPUConfig
+    from cylon_tpu.obs import metrics as obs_metrics
+    from cylon_tpu.parallel import plane as plane_mod
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        # nonzero exit so the battery's `||` CPU-mesh fallback fires
+        print("compress-ab: needs >= 2 devices for a mesh; skipping",
+              flush=True)
+        return False
+    ctx = CylonContext.InitDistributed(TPUConfig(world_size=ndev))
+    r = np.random.default_rng(23)
+    flags = np.array(["A", "N", "R"], object)
+    status = np.array(["F", "O"], object)
+    arrs = {
+        "l_orderkey": r.integers(0, n_rows, n_rows).astype(np.int32),
+        "l_extendedprice": (r.random(n_rows, np.float32) * 90000 + 900),
+        "l_discount": r.integers(0, 11, n_rows).astype(np.float32) / 100,
+        "l_returnflag": flags[r.integers(0, 3, n_rows)],
+        "l_linestatus": status[r.integers(0, 2, n_rows)],
+        "l_shipdate": r.integers(0, 2556, n_rows).astype(np.int32),
+    }
+    t = Table.from_numpy(list(arrs), list(arrs.values()), ctx=ctx)
+    for label, mode in (("plain", "0"), ("compressed", "1")):
+        with config.knob_env(CYLON_TPU_SHUFFLE_PACK="1",
+                             CYLON_TPU_SHUFFLE_COMPRESS=mode):
+            words = plane_mod.plane_words(t.columns)
+            if mode == "1":
+                spec = plane_mod.estimate_spec(t.columns, ctx.GetWorldSize(),
+                                               t.shard_capacity)
+                words = plane_mod.plane_words(t.columns, spec)
+            t.shuffle(["l_orderkey"])  # warm the plan caches
+            best, deltas = None, None
+            for _ in range(REPS):
+                before = dict(obs_metrics.snapshot()["counters"])
+                t0 = time.perf_counter()
+                out = t.shuffle(["l_orderkey"])
+                out.row_count  # force completion
+                dt_s = time.perf_counter() - t0
+                after = dict(obs_metrics.snapshot()["counters"])
+                if best is None or dt_s < best:
+                    best = dt_s
+                    deltas = {k: after.get(k, 0) - before.get(k, 0)
+                              for k in ("shuffle.bytes_sent",
+                                        "shuffle.bytes_saved",
+                                        "shuffle.collective_launches")}
+        print(f"compress-ab {label:10s} {best * 1e3:10.1f} ms  "
+              f"words/row={words} "
+              f"bytes_sent={int(deltas['shuffle.bytes_sent'])} "
+              f"bytes_saved={int(deltas['shuffle.bytes_saved'])} "
+              f"launches={int(deltas['shuffle.collective_launches'])}",
+              flush=True)
+    print("done", flush=True)
+    return True
+
+
 if "--plan-ab" in sys.argv:
     _ok = _plan_ab(_POS_ARGS and int(_POS_ARGS[0]) or (1 << 20))
     if _ok and obs_spans.events_enabled():
         _tp, _mp = obs_export.export_all(prefix="microbench_plan_ab")
+        print(f"trace artifact: {_tp}", flush=True)
+    sys.exit(0 if _ok else 3)
+
+if "--compress-ab" in sys.argv:
+    _ok = _compress_ab(_POS_ARGS and int(_POS_ARGS[0]) or (1 << 20))
+    if _ok and obs_spans.events_enabled():
+        _tp, _mp = obs_export.export_all(prefix="microbench_compress_ab")
         print(f"trace artifact: {_tp}", flush=True)
     sys.exit(0 if _ok else 3)
 

@@ -166,6 +166,19 @@ timeout 900 python tools/microbench.py 4194304 --plan-ab \
     > "$OUT/plan_ab.txt" 2>> "$OUT/plan_ab.log"
 log "plan A/B rc=$? $(head -c 200 "$OUT/plan_ab.txt" 2>/dev/null)"
 
+log "7f/9 compressed shuffle payload A/B (CYLON_TPU_SHUFFLE_COMPRESS)"
+# Tentpole knob (ISSUE 10): bytes_sent + plane words/row + wall per arm on
+# a low-cardinality TPC-H-Q3-shaped shuffle.  The payload-bits saving is a
+# real-ICI effect, so the real accelerator mesh is the verdict when the
+# tunnel is up; the CPU-mesh fallback still records the bytes drop (exact
+# there too) so every battery round carries the A/B.
+timeout 900 python tools/microbench.py 4194304 --compress-ab \
+    > "$OUT/compress_ab.txt" 2> "$OUT/compress_ab.log" \
+  || JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 900 python tools/microbench.py 1048576 --compress-ab \
+    > "$OUT/compress_ab.txt" 2>> "$OUT/compress_ab.log"
+log "compress A/B rc=$? $(head -c 200 "$OUT/compress_ab.txt" 2>/dev/null)"
+
 log "8/9 kernel smoke"
 timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"
 log "smoke rc=$?"
