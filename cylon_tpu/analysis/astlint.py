@@ -57,10 +57,21 @@ COLLECTIVE_NAMES = frozenset({
     "ppermute", "collective_permute", "pmax", "pmin",
 })
 
-#: the elastic control-plane module and its recovery entry points (any
-#: function there named elastic_*), for CY106 reachability
+#: the elastic control-plane module and its recovery entry points, for
+#: CY106 reachability: any function there named elastic_*, plus — since
+#: the PR-11 survivable control plane — any reconnect/ride-through path
+#: (functions whose name contains "reconnect" or "ride_out"): a
+#: reconnected agent resumes against a possibly-restarted coordinator,
+#: so a collective issued from its reconnect path is the same
+#: stale-world hazard as one issued from a resume path
 ELASTIC_MODULE = "cylon_tpu.elastic"
 ELASTIC_ROOT_PREFIX = "elastic_"
+ELASTIC_ROOT_SUBSTRINGS = ("reconnect", "ride_out")
+
+
+def _is_elastic_recovery_root(name: str) -> bool:
+    return (name.startswith(ELASTIC_ROOT_PREFIX)
+            or any(s in name for s in ELASTIC_ROOT_SUBSTRINGS))
 
 #: calls that count as an epoch guard on a recovery path: the agent's
 #: membership check, or an engine-level guard hook
@@ -877,8 +888,10 @@ def _names_bound_to_knobs(mod: _Module) -> Dict[str, Set[str]]:
 
 def _check_elastic_guards(prog: _Program, mod: _Module) -> None:
     """CY106: an elastic recovery entry point (``cylon_tpu.elastic``
-    function named ``elastic_*``) from which a collective is reachable
-    must also reach an epoch guard (``ensure_epoch``/``epoch_guard``).
+    function named ``elastic_*``, or a reconnect/ride-through path —
+    name containing ``reconnect``/``ride_out``) from which a collective
+    is reachable must also reach an epoch guard
+    (``ensure_epoch``/``epoch_guard``).
 
     The invariant behind it: after a membership change, re-issuing a
     collective derived from the OLD world desyncs whoever survived —
@@ -890,7 +903,7 @@ def _check_elastic_guards(prog: _Program, mod: _Module) -> None:
         return
     for f in mod.funcs.values():
         name = f.qual.rsplit(".", 1)[-1]
-        if not name.startswith(ELASTIC_ROOT_PREFIX):
+        if not _is_elastic_recovery_root(name):
             continue
         colls = prog.collective_reach(f)
         if not colls:

@@ -350,7 +350,28 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
     _K("CYLON_TPU_HEARTBEAT_TIMEOUT_S", "float", 2.5, RUNTIME,
        accessors=("cylon_tpu.elastic.heartbeat_timeout",),
        help="Silence window after which the coordinator declares a rank "
-            "dead and bumps the membership epoch (shrink-and-resume)."),
+            "dead and bumps the membership epoch (shrink-and-resume).  "
+            "Must exceed CYLON_TPU_HEARTBEAT_S — agents refuse to start "
+            "under a pair that would instantly fence every rank."),
+    _K("CYLON_TPU_COORD_DIR", "str", "", RUNTIME,
+       accessors=("cylon_tpu.elastic.coord_dir",),
+       help="Durable coordinator state root: the membership ledger, "
+            "epoch counter, incarnation number, fence set, rendezvous "
+            "latches and skew ledger are journaled to an fsync'd "
+            "append-only COORD_LOG.jsonl (torn-tail tolerant), so a "
+            "restarted coordinator recovers its ledger, bumps its "
+            "incarnation, and bumps the epoch once — survivors resume "
+            "instead of dying.  Empty (default) disables coordinator "
+            "durability (a restart then has nothing to recover)."),
+    _K("CYLON_TPU_COORD_RECONNECT_S", "float", 10.0, RUNTIME,
+       accessors=("cylon_tpu.elastic.reconnect_window_s",),
+       help="Bounded coordinator-reconnect window: after 3 failed "
+            "control round trips an agent keeps re-joining under seeded "
+            "full-jitter backoff for this many seconds — in-flight "
+            "local passes keep executing and journaling, only "
+            "membership changes stall — before CoordinatorLost fires "
+            "(classified, Code.Unavailable).  0 reproduces the PR-6 "
+            "fail-after-3-missed-ticks behavior exactly."),
     _K("CYLON_TPU_DEBUG", "bool", False, RUNTIME,
        help="Log every span's duration at INFO (cylon_tpu.obs.spans; the "
             "utils.timing shim's historical switch)."),

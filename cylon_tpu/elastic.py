@@ -38,22 +38,50 @@ processes survives losing a member:
   mesh-shape redistribution argument of arxiv 2112.01075, with the
   journal as the transfer medium.
 
-Coordinator death is NOT survivable (it is the membership ground truth,
-deliberately un-replicated): agents detect it after a few failed
-heartbeats and fail *clean* — `CoordinatorLost`, a classified `Status`
-(`Code.Unavailable`), never a hang.
+Coordinator death is SURVIVABLE since PR 11 (it was PR 6's one
+deliberate single point of failure).  Three pieces make it so:
+
+- **durable coordinator state** — with ``CYLON_TPU_COORD_DIR`` set, the
+  membership ledger, epoch counter, incarnation number, fence set
+  (dead ranks), rendezvous latches and skew ledger are journaled to an
+  fsync'd append-only log (:class:`CoordLog`, the durable.py
+  torn-tail-tolerant manifest discipline).  A restarted coordinator
+  recovers the ledger, bumps its **incarnation**, and bumps the epoch
+  ONCE — survivors resume through the existing journal-backed
+  shrink-and-resume loop instead of dying;
+- **incarnation fencing** — every control verb response carries
+  ``(incarnation, epoch)`` and every agent request carries the highest
+  incarnation the agent has observed.  A stale coordinator that
+  resurrects after a takeover is rejected on BOTH sides: agents discard
+  its responses (`StaleCoordinatorError`), and the stale coordinator
+  itself stands down the moment any verb claims a newer incarnation —
+  no split-brain, mirroring the rank fencing PR 6 does the other way;
+- **client-side ride-through** — agent RPC failures open a bounded
+  reconnect window (``CYLON_TPU_COORD_RECONNECT_S``, full-jitter
+  backoff so a restart does not thundering-herd the one-shot accept
+  loop) during which in-flight local passes keep executing and
+  journaling; only membership changes stall.  `CoordinatorLost` (the
+  clean classified fail, `Code.Unavailable`) still fires when the
+  window expires — and a window of 0 reproduces PR 6's fail-after-3-
+  missed-ticks behavior exactly.
 
 Everything here is host-side stdlib (sockets + threads; no jax), so the
 jaxpr collective-budget goldens are untouched by construction, and every
 recovery path runs deterministically on CPU via the resilience fault
 kinds ``rank_kill`` (``os._exit(137)`` at a pass boundary),
-``heartbeat_loss`` (the agent goes silent but keeps computing) and
-``coordinator_loss`` (the coordinator dies mid-detection) —
-tests/test_elastic.py, tests/elastic_worker.py.
+``heartbeat_loss`` (the agent goes silent but keeps computing),
+``coordinator_loss`` (the coordinator dies mid-detection),
+``coordinator_restart`` (dies AND takes over again in place),
+``coord_partition`` (agent->coordinator messages dropped one-way) and
+``coord_slow`` (delayed verb replies) — composable into seeded
+timelines via ``resilience.FaultSchedule`` — tests/test_elastic.py,
+tests/elastic_worker.py.
 """
 from __future__ import annotations
 
+import json
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -102,6 +130,21 @@ def clock_sync_rounds() -> int:
     return max(1, int(config.knob("CYLON_TPU_CLOCK_SYNC_N")))
 
 
+def coord_dir() -> str:
+    """``CYLON_TPU_COORD_DIR``: durable coordinator state root (the
+    fsync'd append-only `CoordLog`); empty disables durability — a
+    restarted coordinator then has nothing to recover."""
+    return str(config.knob("CYLON_TPU_COORD_DIR"))
+
+
+def reconnect_window_s() -> float:
+    """``CYLON_TPU_COORD_RECONNECT_S``: how long an agent rides out an
+    unreachable coordinator (bounded reconnect window, full-jitter
+    backoff) before declaring `CoordinatorLost`.  0 reproduces the PR-6
+    fail-after-3-missed-ticks behavior exactly."""
+    return max(0.0, float(config.knob("CYLON_TPU_COORD_RECONNECT_S")))
+
+
 #: a kept clock offset older than this is replaced even by a noisier
 #: measurement — bounded staleness beats a lucky-but-ancient RTT
 CLOCK_MAX_AGE_S = 30.0
@@ -142,6 +185,234 @@ class CoordinatorLost(CylonError):
         super().__init__(Code.Unavailable, msg)
 
 
+class StaleCoordinatorError(ConnectionError):
+    """The responder carried an incarnation OLDER than one this agent
+    has already observed (or confessed staleness itself): whatever is
+    answering at the coordinator address is a resurrected pre-takeover
+    coordinator, and absorbing its view would be split-brain.  A
+    ``ConnectionError`` subclass on purpose — every failure-accounting
+    path (heartbeat streaks, barrier polls, the reconnect window)
+    already treats an unreachable coordinator correctly, and a stale
+    one must be *exactly as dead* to this agent."""
+
+
+# ---------------------------------------------------------------------------
+# durable coordinator state
+# ---------------------------------------------------------------------------
+
+COORD_LOG = "COORD_LOG.jsonl"
+
+#: compact the coordinator log once it grows past this many bytes: the
+#: whole durable state is small by construction (bounded members/fences/
+#: latches/skews), so the log is rewritten as ONE snapshot `open` record
+#: — without this, a long run appending a latch + skew row per completed
+#: collective would grow the file (and recovery's parse cost) forever
+COORD_LOG_COMPACT_BYTES = 4 << 20
+
+
+class CoordLog:
+    """Append-only fsync'd journal of the coordinator's control state
+    under ``CYLON_TPU_COORD_DIR`` — the control-plane twin of
+    durable.py's run manifest, with the same crash contract: each record
+    is one JSON line, appended + flushed + fsync'd, and recovery is
+    torn-tail tolerant (a line that fails to parse is the expected
+    shape of a crash mid-append; every complete line before it stands).
+
+    Record kinds::
+
+        open    {incarnation, epoch, world}    coordinator (re)started
+        member  {rank, inc}                     rank joined the gang
+        dead    {rank, reason, epoch, inc}      rank fenced, epoch bumped
+        latch   {name, epoch, inc}              rendezvous completed
+        skew    {row, inc}                      skew-ledger entry
+
+    Every record carries the WRITER's incarnation (``inc``), and
+    recovery discards records whose incarnation is below the highest
+    ``open`` folded so far: a partitioned-but-alive predecessor that
+    never hears the successor's fencing verb (nothing reaches it) may
+    keep appending to the shared log, and without the filter its
+    split-brain ``dead``/epoch records would be folded into a later
+    recovery — exactly the split-brain the verb-level incarnation
+    fencing exists to prevent, smuggled through the disk.
+
+    Writes are best-effort like every durable.py write: an IO failure
+    disables the log for this coordinator (counted, warned) but never
+    fails the membership operation it was recording — durability
+    degrades, the control plane does not."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.path = os.path.join(root, COORD_LOG)
+        self.disabled = False
+        self._lock = threading.Lock()
+
+    @classmethod
+    def open(cls, root: str) -> Optional["CoordLog"]:
+        if not root:
+            return None
+        try:
+            os.makedirs(root, exist_ok=True)
+        except OSError as e:
+            obs_metrics.counter_add("coord.log_errors")
+            log.warning("elastic: cannot open coordinator log under %r "
+                        "(%s: %s); coordinator durability disabled",
+                        root, type(e).__name__, e)
+            return None
+        return cls(root)
+
+    def append(self, entry: Dict) -> bool:
+        return self.append_many([entry])
+
+    def append_many(self, entries: Sequence[Dict]) -> bool:
+        """Write records in order, one fsync for the batch (they are
+        staged under the membership lock and flushed outside it — a slow
+        disk must never stall heartbeat processing into false
+        timeouts)."""
+        if self.disabled or not entries:
+            return not self.disabled
+        try:
+            with self._lock, open(self.path, "a", encoding="utf-8") as fh:
+                for entry in entries:
+                    fh.write(json.dumps(entry, sort_keys=True) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            return True
+        except OSError as e:
+            self.disabled = True
+            obs_metrics.counter_add("coord.log_errors")
+            log.warning("elastic: coordinator log append failed (%s: %s); "
+                        "durability disabled for this coordinator",
+                        type(e).__name__, e)
+            return False
+
+    def size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def rewrite(self, entries: Sequence[Dict]) -> bool:
+        """Atomically replace the whole log with ``entries`` (tmp +
+        fsync + rename — the durable.py spill discipline): compaction.
+        A crash at any point leaves either the old log or the new one,
+        never a mix."""
+        if self.disabled:
+            return False
+        tmp = self.path + f".tmp.{os.getpid()}"
+        try:
+            with self._lock:
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    for entry in entries:
+                        fh.write(json.dumps(entry, sort_keys=True) + "\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, self.path)
+            return True
+        except OSError as e:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            self.disabled = True
+            obs_metrics.counter_add("coord.log_errors")
+            log.warning("elastic: coordinator log rewrite failed (%s: "
+                        "%s); durability disabled for this coordinator",
+                        type(e).__name__, e)
+            return False
+
+    @staticmethod
+    def recover(root: str) -> Optional[Dict]:
+        """Fold the log into the last durable coordinator state, or None
+        when there is no (usable) log.  The returned dict carries
+        ``incarnation``/``epoch``/``world``/``members``/``dead``/
+        ``latches``/``skews`` exactly as of the last complete record.
+        An ``open`` record may carry a full state SNAPSHOT (compaction,
+        restart) — it replaces everything folded so far."""
+        if not root:
+            return None
+        path = os.path.join(root, COORD_LOG)
+        state: Dict = {"incarnation": -1, "epoch": 0, "world": 0,
+                       "members": set(), "dead": {}, "latches": [],
+                       "skews": []}
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for raw in fh:
+                    try:
+                        e = json.loads(raw)
+                    except ValueError:
+                        # a torn line: the expected crash-mid-append
+                        # shape at the TAIL — but SKIPPED, not a replay
+                        # stop, so a garbled mid-file line (two writers
+                        # interleaving buffered appends) cannot silently
+                        # drop every later valid record (a fence entry
+                        # lost here would un-fence a dead rank)
+                        if raw.strip():
+                            log.warning("elastic: coordinator log %s: "
+                                        "skipping malformed record %r",
+                                        path, raw[:80])
+                        continue
+                    kind = e.get("kind")
+                    try:
+                        if kind == "open":
+                            e_inc = int(e["incarnation"])
+                            if e_inc < state["incarnation"]:
+                                # a stale writer's open/snapshot never
+                                # outranks already-folded state
+                                continue
+                            state["incarnation"] = e_inc
+                            state["world"] = int(e.get("world", 0))
+                            state["epoch"] = max(state["epoch"],
+                                                 int(e.get("epoch", 0)))
+                            if "members" in e:  # snapshot open record
+                                state["members"] = {
+                                    int(r) for r in e["members"]}
+                                state["dead"] = {
+                                    int(r): str(w) for r, w
+                                    in (e.get("dead") or {}).items()}
+                                state["latches"] = [
+                                    (str(n), int(ep)) for n, ep
+                                    in (e.get("latches") or [])]
+                                state["skews"] = [
+                                    r for r in (e.get("skews") or [])
+                                    if isinstance(r, dict)]
+                            continue
+                        inc = e.get("inc")
+                        if isinstance(inc, int) \
+                                and inc < state["incarnation"]:
+                            # a stale (superseded, possibly partitioned)
+                            # coordinator kept writing after a takeover:
+                            # its records are split-brain and must not
+                            # fold into the recovered ledger
+                            continue
+                        if kind == "member":
+                            state["members"].add(int(e["rank"]))
+                        elif kind == "dead":
+                            r = int(e["rank"])
+                            state["members"].discard(r)
+                            state["dead"][r] = str(e.get("reason", "?"))
+                            state["epoch"] = max(state["epoch"],
+                                                 int(e.get("epoch", 0)))
+                        elif kind == "latch":
+                            state["latches"].append(
+                                (str(e["name"]), int(e["epoch"])))
+                        elif kind == "skew":
+                            row = e.get("row")
+                            if isinstance(row, dict):
+                                state["skews"].append(row)
+                    except (KeyError, TypeError, ValueError):
+                        log.warning("elastic: coordinator log %s: "
+                                    "skipping half-shaped %r record",
+                                    path, kind)
+                        continue
+        except OSError:
+            return None
+        if state["incarnation"] < 0:
+            return None  # no complete `open` record: nothing durable
+        state["latches"] = state["latches"][-256:]
+        state["skews"] = state["skews"][-64:]
+        return state
+
+
 @dataclass(frozen=True)
 class MemberView:
     """One consistent observation of the membership ledger."""
@@ -174,7 +445,8 @@ class Coordinator:
     """
 
     def __init__(self, world: int, host: str = "127.0.0.1", port: int = 0,
-                 heartbeat_timeout_s: Optional[float] = None):
+                 heartbeat_timeout_s: Optional[float] = None,
+                 log_dir: Optional[str] = None):
         if world < 1:
             raise CylonError(Code.Invalid, f"world must be >= 1, got {world}")
         self.world = int(world)
@@ -182,8 +454,10 @@ class Coordinator:
                         else max(0.05, float(heartbeat_timeout_s)))
         self._lock = threading.Lock()
         self._epoch = 0
+        self.incarnation = 0                     # fencing token, bumped
+        self.stale = False                       # superseded: stand down
         self._last_hb: Dict[int, float] = {}     # alive ranks -> monotonic
-        self._dead: Dict[int, str] = {}          # rank -> reason
+        self._dead: Dict[int, str] = {}          # rank -> reason (FENCE set)
         # barrier arrival instants (coordinator clock, perf_counter_ns):
         # rank -> first-arrival timestamp; on completion the spread is the
         # collective's SKEW — the slowest participant's cost to everyone
@@ -192,16 +466,72 @@ class Coordinator:
         self._clocks: Dict[int, Dict] = {}       # rank -> offset/uncertainty
         self._telemetry: Dict[int, Dict] = {}    # rank -> serve telemetry
         self._skews: "deque[Dict]" = deque(maxlen=64)
-        self._pending_flight: List[Dict] = []    # staged rank-loss dumps
+        self._pending_flight: List[Tuple[str, Dict]] = []  # staged dumps
+        self._pending_log: List[Dict] = []       # staged CoordLog records
+        self._log_flush_lock = threading.Lock()  # keeps batches ordered
         # latched completed rendezvous, insertion-ordered dict-as-set so
         # the bound evicts oldest-first (a slow member only ever polls a
         # RECENTLY completed barrier)
         self._completed_barriers: Dict[Tuple[str, int], bool] = {}
         self._stop = threading.Event()
         self.died = False                        # coordinator_loss fired
+        # durable state: recover the ledger a predecessor journaled under
+        # CYLON_TPU_COORD_DIR (or the explicit log_dir), then journal our
+        # own `open` — a plain fresh start (no log) opens at incarnation 0
+        self._log_dir = coord_dir() if log_dir is None else str(log_dir)
+        recovered = CoordLog.recover(self._log_dir)
+        self.restored = recovered is not None
+        if recovered is not None:
+            self._adopt_recovered(recovered)
+        self._log = CoordLog.open(self._log_dir)
+        if self._log is not None:
+            # the open record is a full SNAPSHOT and REPLACES the log:
+            # history before this incarnation is already folded into it,
+            # so the file never accumulates dead lifetimes
+            self._log.rewrite([self._snapshot_locked()])
         self._server = control.JsonServer(self._handle, host=host, port=port)
         self.address: Tuple[str, int] = self._server.address
         self._detector: Optional[threading.Thread] = None
+
+    def _adopt_recovered(self, rec: Dict) -> None:
+        """Fold a recovered `CoordLog` state in: restart-with-takeover.
+        The incarnation bumps (the fencing token a stale predecessor can
+        never present) and the epoch bumps ONCE — every survivor's next
+        guard raises `EpochChanged` and the ordinary shrink-and-resume
+        loop re-derives the assignment; the fence set carries over, so a
+        rank fenced before the crash stays fenced after it.  Recovered
+        members get a fresh heartbeat stamp: a full timeout window to
+        reconnect before the detector may reap them."""
+        if rec.get("world") and int(rec["world"]) != self.world:
+            log.warning("elastic: recovered coordinator log records "
+                        "world=%d (constructor said %d); trusting the log",
+                        int(rec["world"]), self.world)
+            self.world = int(rec["world"])
+        self.incarnation = int(rec["incarnation"]) + 1
+        self._epoch = int(rec["epoch"]) + 1
+        self._dead = {int(r): str(w) for r, w in rec["dead"].items()}
+        # recovered members are stamped one full timeout INTO THE FUTURE:
+        # this coordinator cannot have heard anyone before it existed,
+        # and the survivors it owes a reconnect window to are busy
+        # riding out the very outage it is recovering from — reaping one
+        # for silence accrued against a dead predecessor would turn a
+        # survivable restart into a fencing
+        grace = time.monotonic() + self.timeout
+        self._last_hb = {int(r): grace for r in sorted(rec["members"])}
+        self._completed_barriers = {
+            (str(n), int(e)): True for n, e in rec["latches"]}
+        self._skews = deque(rec["skews"], maxlen=64)
+
+    def _snapshot_locked(self) -> Dict:
+        """The full durable state as ONE `open` record — what the log is
+        compacted to, and what a successor recovers from."""
+        return {"kind": "open", "incarnation": self.incarnation,
+                "epoch": self._epoch, "world": self.world,
+                "members": sorted(self._last_hb),
+                "dead": {str(r): w for r, w in sorted(self._dead.items())},
+                "latches": [[n, e] for n, e in self._completed_barriers],
+                "skews": list(self._skews),
+                "ts_unix": time.time()}
 
     # -- lifecycle -------------------------------------------------------
 
@@ -210,9 +540,26 @@ class Coordinator:
         self._detector = threading.Thread(target=self._detect, daemon=True,
                                           name="cylon-elastic-detector")
         self._detector.start()
-        log.info("elastic: coordinator up at %s:%d (world=%d, "
-                 "heartbeat timeout %.2fs)", *self.address, self.world,
-                 self.timeout)
+        obs_metrics.gauge_set("elastic.incarnation", self.incarnation)
+        if self.restored:
+            obs_spans.instant("coord.restart", incarnation=self.incarnation,
+                              epoch=self._epoch,
+                              members=sorted(self._last_hb))
+            obs_metrics.counter_add("coord.restart")
+            obs_fleet.flight_record(
+                "coord_restart", rank="coord",
+                incarnation=self.incarnation, epoch=self._epoch,
+                members=sorted(self._last_hb), dead=dict(self._dead))
+            log.warning("elastic: coordinator RESTARTED at %s:%d from "
+                        "durable log (incarnation=%d, epoch=%d, "
+                        "members=%s, fenced=%s)", *self.address,
+                        self.incarnation, self._epoch,
+                        sorted(self._last_hb), sorted(self._dead))
+        else:
+            log.info("elastic: coordinator up at %s:%d (world=%d, "
+                     "heartbeat timeout %.2fs, incarnation=%d)",
+                     *self.address, self.world, self.timeout,
+                     self.incarnation)
         return self
 
     def stop(self) -> None:
@@ -238,13 +585,24 @@ class Coordinator:
                 if e.kind == "coordinator_loss":
                     self._die()
                     return
+                if e.kind == "coordinator_restart":
+                    # crash + takeover, compressed: down for the injected
+                    # outage, then back at the SAME address with the
+                    # durable ledger, a bumped incarnation and epoch —
+                    # exactly what agents must ride through
+                    self.restart(down_s=resilience.fault_delay_s())
+                    continue
                 raise
             now = time.monotonic()
             with self._lock:
-                late = [r for r, hb in self._last_hb.items()
-                        if now - hb > self.timeout]
+                # a superseded coordinator must not fence anyone: its
+                # ledger is no longer the ground truth
+                late = [] if self.stale else \
+                    [r for r, hb in self._last_hb.items()
+                     if now - hb > self.timeout]
                 for rank in late:
                     self._mark_dead_locked(rank, "heartbeat timeout")
+            self._flush_log()
             self._flush_flight()
 
     def _mark_dead_locked(self, rank: int, reason: str) -> None:
@@ -258,6 +616,12 @@ class Coordinator:
         self._telemetry.pop(rank, None)
         self._dead[rank] = reason
         self._epoch += 1
+        # the fence + epoch bump is durable state: a coordinator that
+        # restarts must remember who it fenced (STAGED like the flight
+        # dumps — fsync latency never holds the membership lock)
+        self._pending_log.append({"kind": "dead", "rank": int(rank),
+                                  "reason": reason, "epoch": self._epoch,
+                                  "inc": self.incarnation})
         # rank loss is a classified terminal event: the coordinator's
         # flight dump records WHO died, WHY, and the control-plane events
         # leading up to it — even when the dead process took its own
@@ -267,9 +631,10 @@ class Coordinator:
         # cascading false timeouts.  A clean leave is not a failure and
         # does not dump.
         if reason != "left":
-            self._pending_flight.append(dict(
+            self._pending_flight.append(("rank_lost", dict(
                 lost_rank=rank, loss_reason=reason, epoch=self._epoch,
-                members=sorted(self._last_hb)))
+                incarnation=self.incarnation,
+                members=sorted(self._last_hb))))
         # pending barriers from earlier epochs can never complete (their
         # pollers get epoch_changed and re-enter at the new epoch): drop
         # them so arrival sets don't accumulate across a long shrink
@@ -288,7 +653,8 @@ class Coordinator:
     def _view_locked(self) -> Dict:
         return {"epoch": self._epoch,
                 "members": sorted(self._last_hb),
-                "world": self.world}
+                "world": self.world,
+                "incarnation": self.incarnation}
 
     def _record_skew_locked(self, name: str, epoch: int,
                             arrived: Dict[int, int]) -> None:
@@ -301,11 +667,14 @@ class Coordinator:
         obs_metrics.hist_observe("collective.skew_ns", skew_ns)
         obs_spans.instant("collective.skew", collective=name, epoch=epoch,
                           skew_ns=skew_ns, slowest_rank=slowest)
-        self._skews.append({
+        row = {
             "collective": name, "epoch": epoch, "skew_ns": int(skew_ns),
             "slowest_rank": int(slowest),
             "arrivals_ns": {str(r): int(t - first)
-                            for r, t in sorted(arrived.items())}})
+                            for r, t in sorted(arrived.items())}}
+        self._skews.append(row)
+        self._pending_log.append({"kind": "skew", "row": row,
+                                   "inc": self.incarnation})
 
     def _serve_status_locked(self) -> Dict:
         """Aggregate the per-rank serve telemetry heartbeats carry: total
@@ -332,42 +701,200 @@ class Coordinator:
         return MemberView(v["epoch"], tuple(v["members"]), v["world"])
 
     def _flush_flight(self) -> None:
-        """Write the staged rank-loss flight dumps OUTSIDE the
-        membership lock (called after each detector sweep and each
-        handled request)."""
+        """Write the staged flight dumps (rank losses, stale fencing)
+        OUTSIDE the membership lock (called after each detector sweep
+        and each handled request)."""
+        if not self._pending_flight:  # unlocked fast path (hot verbs)
+            return
         while True:
             with self._lock:
                 if not self._pending_flight:
                     return
-                kw = self._pending_flight.pop(0)
-            obs_fleet.flight_record("rank_lost", rank="coord", **kw)
+                reason, kw = self._pending_flight.pop(0)
+            # the incarnation was stamped when the event was STAGED: a
+            # dump flushed after a restart must attribute its terminal
+            # event to the coordinator lifetime that recorded it
+            obs_fleet.flight_record(reason, rank="coord", **kw)
+
+    def _flush_log(self) -> None:
+        """Drain the staged `CoordLog` records OUTSIDE the membership
+        lock.  The flush lock serializes concurrent drains so batches
+        land in staging order (a `dead` record may never precede its
+        rank's `member` record)."""
+        if self._log is None or not self._pending_log:
+            return  # unlocked empty check: this runs after EVERY verb
+        with self._log_flush_lock:
+            with self._lock:
+                entries, self._pending_log = self._pending_log, []
+            self._log.append_many(entries)
+            if self._log.size() > COORD_LOG_COMPACT_BYTES \
+                    and not self.stale:
+                # bounded growth: fold everything into one snapshot
+                # `open` record (a long run appends a latch + skew row
+                # per collective; recovery only ever wants the tail).
+                # A rewrite is DESTRUCTIVE where plain appends are not
+                # (a stale writer's appends are filtered at recovery by
+                # incarnation; a stale rewrite would erase the
+                # successor's ledger outright) — so before compacting,
+                # re-read the file and verify this coordinator still
+                # OWNS it; a higher incarnation on disk means a
+                # takeover happened behind a partition and this
+                # coordinator must stand down instead
+                on_disk = CoordLog.recover(self._log_dir)
+                if on_disk is not None \
+                        and on_disk["incarnation"] > self.incarnation:
+                    with self._lock:
+                        self.stale = True
+                    obs_spans.instant("coord.stale_fenced",
+                                      incarnation=self.incarnation,
+                                      superseded_by=on_disk["incarnation"])
+                    obs_metrics.counter_add("coord.stale_fenced")
+                    log.warning(
+                        "elastic: coordinator incarnation %d found "
+                        "incarnation %d on its own log at compaction: "
+                        "superseded behind a partition; standing down",
+                        self.incarnation, on_disk["incarnation"])
+                    return
+                with self._lock:
+                    snap = self._snapshot_locked()
+                if self._log.rewrite([snap]):
+                    obs_spans.instant("coord.log_compacted",
+                                      bytes=self._log.size())
+                    obs_metrics.counter_add("coord.log_compactions")
+
+    def restart(self, down_s: float = 0.0) -> "Coordinator":
+        """Crash + restart-with-takeover compressed into one object (the
+        ``coordinator_restart`` fault kind and the in-process tests):
+        drop the socket, stay dark for ``down_s`` (agents accumulate
+        failures and enter their reconnect windows), then recover the
+        durable ledger, bump incarnation and epoch once, and rebind the
+        SAME address.  Without a coordinator log the live in-memory
+        state stands in for the recovered ledger (a state-transfer
+        takeover) — incarnation and epoch still bump, so agents observe
+        an indistinguishable restart."""
+        host, port = self.address
+        self._server.close()
+        if down_s > 0:
+            time.sleep(down_s)
+        self._flush_flight()  # staged dumps carry their stamped (old)
+        #                       incarnation; write them out pre-bump
+        with self._log_flush_lock:
+            # drain + recover + bump under ONE membership-lock hold (a
+            # cold path; the server socket is already closed): a fence
+            # record staged by an in-flight handler must land in the log
+            # BEFORE the incarnation bumps — flushed after the new open
+            # it would carry the old incarnation and the stale-writer
+            # filter would durably drop it, un-fencing a dead rank
+            with self._lock:
+                entries, self._pending_log = self._pending_log, []
+                if self._log is not None:
+                    self._log.append_many(entries)
+                # adopt the disk ledger only while the log is HEALTHY:
+                # once an IO failure disabled it, the file is stale
+                # relative to live memory (fences recorded since are
+                # only in RAM) — recovering it would un-fence dead
+                # ranks and skip the epoch bump survivors resume on
+                recovered = (CoordLog.recover(self._log_dir)
+                             if self._log is not None
+                             and not self._log.disabled else None)
+                if recovered is not None:
+                    self._adopt_recovered(recovered)
+                else:
+                    self.incarnation += 1
+                    self._epoch += 1
+                    now = time.monotonic()
+                    self._last_hb = {r: now
+                                     for r in sorted(self._last_hb)}
+                self._barriers.clear()   # pending arrivals died with the
+                self._clocks.clear()     # old incarnation; latches are
+                self._telemetry.clear()  # durable
+                self.stale = False
+                self.died = False
+                self.restored = True
+                inc, epoch = self.incarnation, self._epoch
+                members = sorted(self._last_hb)
+                snap = self._snapshot_locked()
+            if self._log is not None:
+                self._log.rewrite([snap])
+        self._server = control.JsonServer(self._handle, host=host,
+                                          port=port)
+        self._server.start()
+        obs_spans.instant("coord.restart", incarnation=inc, epoch=epoch,
+                          members=members, down_s=down_s)
+        obs_metrics.counter_add("coord.restart")
+        obs_metrics.gauge_set("elastic.incarnation", inc)
+        obs_fleet.flight_record("coord_restart", rank="coord",
+                                incarnation=inc, epoch=epoch,
+                                members=members, dead=dict(self._dead))
+        log.warning("elastic: coordinator RESTARTED in place at %s:%d "
+                    "(incarnation=%d, epoch=%d, members=%s)", host, port,
+                    inc, epoch, members)
+        return self
 
     def _handle(self, req: Dict) -> Dict:
         try:
             return self._handle_inner(req)
         finally:
             # report_failure / leave mark ranks dead under the lock;
-            # their dumps are written here, after it is released
+            # their log records + dumps are written here, after release
+            self._flush_log()
             self._flush_flight()
 
     def _handle_inner(self, req: Dict) -> Dict:
         t_recv = time.perf_counter_ns()
         cmd = req.get("cmd")
         rank = req.get("rank")
+        # coord_slow injection: a delayed reply, not a lost one
+        resilience.fault_point("elastic.coord.verb")
+        claim = req.get("coord_incarnation")
         if cmd == "clock":
             # the NTP-style handshake leg: lock-free, so a blocked
             # membership operation cannot inflate the apparent one-way
             # delay (uncertainty IS the product here).  Fenced ranks may
             # still sync — a straggler's post-mortem trace needs
-            # alignment more than anyone's.
+            # alignment more than anyone's.  Staleness is checked with a
+            # plain attribute read (a superseded clock reference must
+            # not be merged against), and the stand-down WRITE is left
+            # to the membership verbs so this path never takes the lock.
+            if self.stale:
+                return {"ok": False, "status": "stale_coordinator",
+                        "incarnation": self.incarnation,
+                        "error": "superseded coordinator incarnation"}
             return {"ok": True, "t_recv": t_recv,
                     "t_send": time.perf_counter_ns()}
         with self._lock:
+            # incarnation fencing, coordinator side, under the SAME lock
+            # hold as the verb dispatch below (one acquisition, and the
+            # "stale answers only stale_coordinator" invariant holds
+            # atomically): a request claiming a NEWER incarnation proves
+            # a takeover happened and THIS coordinator is the stale
+            # resurrection — it stands down for good (stops fencing
+            # ranks, answers only its own staleness) rather than run a
+            # split-brain membership ledger
+            if isinstance(claim, int) and claim > self.incarnation \
+                    and not self.stale:
+                self.stale = True
+                obs_spans.instant("coord.stale_fenced",
+                                  incarnation=self.incarnation,
+                                  superseded_by=claim)
+                obs_metrics.counter_add("coord.stale_fenced")
+                self._pending_flight.append(("stale_coordinator", dict(
+                    superseded_by=claim, epoch=self._epoch,
+                    incarnation=self.incarnation)))
+                log.warning("elastic: coordinator incarnation %d fenced "
+                            "off by a verb from incarnation %s: standing "
+                            "down", self.incarnation, claim)
+            if self.stale:
+                return {"ok": False, "status": "stale_coordinator",
+                        "incarnation": self.incarnation,
+                        "error": "superseded coordinator incarnation"}
             if cmd == "status":
                 now = time.monotonic()
+                # clamp: recovered members carry a grace stamp in the
+                # FUTURE, which must not surface as a negative age
                 return {"ok": True, "dead": dict(self._dead),
                         "ranks": {str(r): {
-                            "hb_age_s": round(now - hb, 6),
+                            "hb_age_s": round(max(0.0, now - hb), 6),
                             "clock": self._clocks.get(r)}
                             for r, hb in sorted(self._last_hb.items())},
                         "serve": self._serve_status_locked(),
@@ -387,13 +914,26 @@ class Coordinator:
                             "error": f"rank {rank} outside world "
                                      f"{self.world}"}
                 self._last_hb[rank] = time.monotonic()
+                self._pending_log.append({"kind": "member",
+                                          "rank": int(rank),
+                                          "inc": self.incarnation})
                 log.info("elastic: rank %d joined (%d/%d)", rank,
                          len(self._last_hb) + len(self._dead), self.world)
                 return {"ok": True, **self._view_locked()}
             if cmd == "heartbeat":
                 if rank not in self._last_hb:
-                    return {"ok": False, "status": "rejected",
-                            "reason": "unknown rank", **self._view_locked()}
+                    if 0 <= rank < self.world:
+                        # implicit re-join: a live rank this ledger does
+                        # not know (its member record fell past a torn
+                        # tail on recovery) must not read as fenced —
+                        # fencing is only ever recorded in the dead set
+                        self._pending_log.append({"kind": "member",
+                                                  "rank": int(rank),
+                                                  "inc": self.incarnation})
+                    else:
+                        return {"ok": False, "status": "rejected",
+                                "reason": "unknown rank",
+                                **self._view_locked()}
                 self._last_hb[rank] = time.monotonic()
                 ci = req.get("clock")
                 if isinstance(ci, dict):
@@ -433,6 +973,14 @@ class Coordinator:
                     while len(self._completed_barriers) > 256:
                         self._completed_barriers.pop(
                             next(iter(self._completed_barriers)))
+                    # the latch is durable: completion is monotone even
+                    # across a coordinator restart (a finished member's
+                    # leave must not fake an epoch change for peers that
+                    # poll the restarted coordinator)
+                    self._pending_log.append({"kind": "latch",
+                                              "name": name,
+                                              "epoch": int(epoch),
+                                              "inc": self.incarnation})
                     self._record_skew_locked(name, epoch, arrived)
                     return {"ok": True, "status": "go",
                             **self._view_locked()}
@@ -473,22 +1021,43 @@ class Agent:
     def __init__(self, address, rank: int,
                  interval_s: Optional[float] = None,
                  timeout_s: Optional[float] = None,
-                 join_timeout_s: float = 20.0):
+                 join_timeout_s: float = 20.0,
+                 reconnect_s: Optional[float] = None):
         self.rank = int(rank)
         self._addr = _parse_address(address)
         self.interval = (heartbeat_interval() if interval_s is None
                          else max(0.01, float(interval_s)))
         self._rpc_timeout = (heartbeat_timeout() if timeout_s is None
                              else max(0.05, float(timeout_s)))
+        # knob-coherence gate: a timeout at or below the cadence means
+        # every rank misses its window BETWEEN two ordinary beats — the
+        # whole gang silently fences itself the moment it forms.  Fail
+        # loud at construction with both values in the message instead.
+        if self._rpc_timeout <= self.interval:
+            raise CylonError(
+                Code.Invalid,
+                f"rank {self.rank}: CYLON_TPU_HEARTBEAT_TIMEOUT_S="
+                f"{self._rpc_timeout:g} must exceed CYLON_TPU_HEARTBEAT_S="
+                f"{self.interval:g} — a timeout at or below the heartbeat "
+                f"cadence instantly fences every rank")
+        self.reconnect_s = (reconnect_window_s() if reconnect_s is None
+                            else max(0.0, float(reconnect_s)))
         self._join_timeout = join_timeout_s
         self._lock = threading.Lock()
         self._epoch = -1
+        self._coord_inc = -1        # highest coordinator incarnation seen
         self._members: Tuple[int, ...] = ()
         self._world = 0
         self._stop = threading.Event()
         self._coord_down = False
         self._fenced = False        # coordinator declared US dead
         self._silenced = False      # heartbeat_loss fault: stop beating
+        # reconnect-window expiry (monotonic), opened when a failure
+        # streak crosses MAX_RPC_FAILURES — NOT at the first failure:
+        # slow RPC timeouts accruing the streak must not eat the window
+        # before a single reconnect attempt is made
+        self._window_until: Optional[float] = None
+        self._reconnecting = False
         self._thread: Optional[threading.Thread] = None
         self.clock: Optional[obs_fleet.ClockInfo] = None
         self._telemetry_fn: Optional[Callable[[], Dict]] = None
@@ -548,7 +1117,67 @@ class Agent:
     # -- protocol --------------------------------------------------------
 
     def _rpc(self, obj: Dict) -> Dict:
-        return control.request(self._addr, obj, timeout=self._rpc_timeout)
+        """One control verb with incarnation fencing on both edges: the
+        request carries the highest coordinator incarnation this agent
+        has observed (a stale resurrected coordinator stands down on
+        seeing it), and a response carrying an OLDER incarnation — or a
+        staleness confession — raises `StaleCoordinatorError`, which
+        every failure-accounting path treats exactly like an
+        unreachable coordinator.  Any success closes the reconnect
+        window the failure-streak paths may have opened."""
+        try:
+            resilience.fault_point(f"elastic.rpc.r{self.rank}")
+        except resilience.InjectedFault as e:
+            if e.kind == "coord_partition":
+                # one-way drop: the request never reaches the wire
+                raise ConnectionError(str(e)) from e
+            raise
+        with self._lock:
+            known = self._coord_inc
+        if known >= 0:
+            obj = dict(obj, coord_incarnation=known)
+        resp = control.request(self._addr, obj,
+                               timeout=self._rpc_timeout)
+        if resp.get("status") == "stale_coordinator":
+            raise StaleCoordinatorError(
+                f"rank {self.rank}: responder at {self._addr[0]}:"
+                f"{self._addr[1]} is a superseded coordinator "
+                f"(incarnation {resp.get('incarnation')})")
+        inc = resp.get("incarnation")
+        with self._lock:
+            stale = isinstance(inc, int) and inc < self._coord_inc
+            if not stale:
+                self._window_until = None  # a real success closes the
+                #                            reconnect window
+        if stale:
+            raise StaleCoordinatorError(
+                f"rank {self.rank}: response carries coordinator "
+                f"incarnation {inc} < observed {self._coord_inc} "
+                f"(stale resurrection; discarding)")
+        return resp
+
+    def _open_window(self) -> float:
+        """Open (or read) the reconnect-window deadline: the FULL
+        ``reconnect_s`` measured from the moment a failure streak
+        crossed ``MAX_RPC_FAILURES`` — shared between the heartbeat and
+        barrier threads, so whichever crosses first anchors it."""
+        with self._lock:
+            if self._window_until is None:
+                self._window_until = time.monotonic() + self.reconnect_s
+            return self._window_until
+
+    def _declare_lost(self, why: str) -> None:
+        with self._lock:
+            already = self._coord_down
+            self._coord_down = True
+        if already:
+            return
+        obs_spans.instant("elastic.coordinator_lost", rank=self.rank,
+                          reason=why[:200])
+        obs_fleet.flight_record("coordinator_lost", rank=self.rank,
+                                error=why[:500])
+        log.warning("elastic: rank %d lost the coordinator: %s",
+                    self.rank, why)
 
     # -- clock alignment + telemetry -------------------------------------
 
@@ -602,8 +1231,16 @@ class Agent:
     def _absorb(self, resp: Dict) -> None:
         """Fold a coordinator response's view into the local mirror.
         Same-epoch responses still refresh members (ranks JOINING during
-        formation don't bump the epoch — only losses do)."""
+        formation don't bump the epoch — only losses do).  An advanced
+        incarnation means the coordinator restarted: adopt it (the epoch
+        advanced with it, so the ordinary guards drive the resume)."""
+        advanced = None
         with self._lock:
+            inc = resp.get("incarnation")
+            if isinstance(inc, int) and inc > self._coord_inc:
+                if self._coord_inc >= 0:
+                    advanced = (self._coord_inc, inc)
+                self._coord_inc = inc
             epoch = int(resp.get("epoch", -1))
             if epoch > self._epoch:
                 self._epoch = epoch
@@ -614,6 +1251,15 @@ class Agent:
             self._world = int(resp.get("world", self._world))
             if resp.get("status") == "rejected":
                 self._fenced = True
+        if isinstance(inc, int):
+            obs_fleet.set_incarnation(inc)
+        if advanced is not None:
+            obs_spans.instant("coord.restart_observed", rank=self.rank,
+                              from_incarnation=advanced[0],
+                              to_incarnation=advanced[1])
+            obs_metrics.gauge_set("elastic.incarnation", advanced[1])
+            log.warning("elastic: rank %d observed coordinator restart "
+                        "(incarnation %d -> %d)", self.rank, *advanced)
 
     def _beat(self) -> None:
         fails = 0
@@ -634,18 +1280,9 @@ class Agent:
             except OSError as e:
                 fails += 1
                 if fails >= self.MAX_RPC_FAILURES:
-                    with self._lock:
-                        self._coord_down = True
-                    obs_spans.instant("elastic.coordinator_lost",
-                                      rank=self.rank, failures=fails)
-                    obs_fleet.flight_record(
-                        "coordinator_lost", rank=self.rank, failures=fails,
-                        error=f"{type(e).__name__}: {e}")
-                    log.warning(
-                        "elastic: rank %d lost the coordinator after %d "
-                        "failed heartbeats (%s: %s)", self.rank, fails,
-                        type(e).__name__, e)
-                    return
+                    if not self._ride_out(e, fails):
+                        return
+                    fails = 0
                 continue
             fails = 0
             self._absorb(resp)
@@ -657,6 +1294,109 @@ class Agent:
                 self.sync_clock(rounds=1)
             except (OSError, ValueError):
                 pass  # the next beat's failure accounting will notice
+
+    def _ride_out(self, err: Exception, fails: int) -> bool:
+        """The bounded reconnect window: the PR-6 contract fired
+        `CoordinatorLost` right here, after ``MAX_RPC_FAILURES`` missed
+        ticks; with ``CYLON_TPU_COORD_RECONNECT_S`` > 0 the agent
+        instead keeps re-joining (``hello`` — idempotent for a live
+        member, and the re-registration a RESTARTED coordinator needs)
+        under seeded full-jitter backoff while in-flight local passes
+        keep executing and journaling.  Returns True when reconnected
+        (the beat loop resumes), False when the window expired, the
+        agent was fenced, or it was stopped — `coordinator_down` /
+        `fenced` then carry the terminal state to every guard."""
+        why = (f"{self.MAX_RPC_FAILURES} heartbeats failed "
+               f"({type(err).__name__}: {err})")
+        if self.reconnect_s <= 0:
+            self._declare_lost(why)
+            return False
+        # the FULL window, measured from this streak declaration — not
+        # from the first failure (whose slow RPC timeouts already cost
+        # up to MAX_RPC_FAILURES round trips); the loop below re-reads
+        # it every round, so only the opening side effect matters here
+        self._open_window()
+        with self._lock:
+            self._reconnecting = True
+        obs_spans.instant("coord.reconnect_wait", rank=self.rank,
+                          window_s=self.reconnect_s, failures=fails)
+        log.warning("elastic: rank %d coordinator unreachable (%s); "
+                    "riding through a %.1fs reconnect window",
+                    self.rank, why, self.reconnect_s)
+        # full jitter, seeded by rank: survivors of one restart spread
+        # their re-joins instead of thundering into the accept loop in
+        # lockstep — and each rank's schedule replays deterministically
+        policy = resilience.RetryPolicy(
+            max_retries=0, base_s=max(self.interval, 0.02),
+            max_s=max(4 * self.interval, 0.25), jitter="full",
+            jitter_seed=self.rank + 1)
+        attempt = 0
+        try:
+            while True:
+                # re-read the SHARED window each round: a concurrent
+                # thread's successful RPC (a barrier poll doubling as a
+                # reconnect probe) closes it, and declaring the
+                # coordinator lost against a stale local deadline after
+                # someone else already reconnected would fail a healthy
+                # run
+                with self._lock:
+                    deadline = self._window_until
+                now = time.monotonic()
+                if deadline is None:
+                    log.info("elastic: rank %d reconnect window closed "
+                             "by a concurrent successful round trip",
+                             self.rank)
+                    return True
+                if now >= deadline:
+                    self._declare_lost(
+                        f"reconnect window "
+                        f"(CYLON_TPU_COORD_RECONNECT_S="
+                        f"{self.reconnect_s:g}s) expired after {attempt} "
+                        f"attempts; last error: {why}")
+                    return False
+                # the raw attempt index keeps the jitter draw advancing
+                # (delay() saturates the exponential internally) — a
+                # capped index would freeze every late retry at one
+                # fixed per-rank delay
+                d = min(policy.delay(attempt), max(0.0, deadline - now))
+                if self._stop.wait(d):
+                    return False
+                attempt += 1
+                try:
+                    resp = self._rpc({"cmd": "hello", "rank": self.rank})
+                except OSError as e:
+                    why = f"{type(e).__name__}: {e}"
+                    continue
+                self._absorb(resp)
+                if resp.get("status") == "rejected" \
+                        or not resp.get("ok", False):
+                    # the (possibly restarted) coordinator fenced us off
+                    with self._lock:
+                        self._fenced = True
+                    log.warning("elastic: rank %d rejected on reconnect "
+                                "(fenced): %s", self.rank, resp)
+                    return False
+                obs_spans.instant("coord.reconnect", rank=self.rank,
+                                  attempts=attempt,
+                                  incarnation=self.incarnation,
+                                  epoch=self.epoch)
+                obs_metrics.counter_add("coord.reconnect")
+                log.warning("elastic: rank %d reconnected to the "
+                            "coordinator after %d attempt(s) "
+                            "(incarnation=%d, epoch=%d)", self.rank,
+                            attempt, self.incarnation, self.epoch)
+                # re-registration: push clock + telemetry NOW so the
+                # restarted coordinator's status view repopulates without
+                # waiting out a full heartbeat interval
+                try:
+                    self.sync_clock()
+                    self._absorb(self._rpc(self._heartbeat_payload()))
+                except (OSError, ValueError):
+                    pass  # the beat loop's accounting takes over
+                return True
+        finally:
+            with self._lock:
+                self._reconnecting = False
 
     # -- views + guards --------------------------------------------------
 
@@ -697,6 +1437,23 @@ class Agent:
     def epoch(self) -> int:
         with self._lock:
             return self._epoch
+
+    @property
+    def incarnation(self) -> int:
+        """Highest coordinator incarnation this agent has observed (-1
+        before the first response): the fencing token a stale
+        resurrected coordinator can never present."""
+        with self._lock:
+            return self._coord_inc
+
+    @property
+    def reconnecting(self) -> bool:
+        """True while the agent is inside its bounded reconnect window
+        (the coordinator is unreachable but not yet declared lost):
+        local passes keep executing and journaling; only membership
+        changes stall."""
+        with self._lock:
+            return self._reconnecting
 
     @property
     def members(self) -> Tuple[int, ...]:
@@ -781,12 +1538,21 @@ class Agent:
             except OSError as e:
                 fails += 1
                 if fails >= self.MAX_RPC_FAILURES:
-                    with self._lock:
-                        self._coord_down = True
-                    raise CoordinatorLost(
-                        f"rank {self.rank}: coordinator unreachable at "
-                        f"barrier {name!r} ({fails} attempts: "
-                        f"{type(e).__name__}: {e})") from e
+                    # inside the reconnect window the rendezvous STALLS
+                    # instead of failing (the heartbeat thread is
+                    # re-joining; this poll keeps trying too — barrier
+                    # polls double as reconnect probes); past it, or
+                    # with the window disabled, the PR-6 clean fail
+                    if (self.reconnect_s <= 0
+                            or time.monotonic() >= self._open_window()
+                            or self.coordinator_down):
+                        self._declare_lost(
+                            f"unreachable at barrier {name!r} "
+                            f"({fails} attempts: {type(e).__name__}: {e})")
+                        raise CoordinatorLost(
+                            f"rank {self.rank}: coordinator unreachable "
+                            f"at barrier {name!r} ({fails} attempts: "
+                            f"{type(e).__name__}: {e})") from e
                 time.sleep(self.interval)
                 continue
             fails = 0

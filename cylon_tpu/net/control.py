@@ -21,7 +21,28 @@ import socket
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..status import Status
+
 MAX_LINE = 1 << 20  # a control message is small; a longer line is a bug
+
+
+class ProtocolError(ConnectionError):
+    """A deterministic wire-contract violation (e.g. a message past
+    ``MAX_LINE``): NOT transient — re-sending the same request fails
+    identically, so the retry logic below must never touch it."""
+
+
+#: mid-verb failure shapes one immediate retry may heal: the peer (or a
+#: middlebox) tore the connection down AFTER accepting it — a fresh
+#: connection usually lands on a healthy accept.  A plain
+#: ``ConnectionError`` is recv_json's "peer closed mid-message", the
+#: clean-close spelling of the same reset.  ``ConnectionRefusedError``
+#: is deliberately NOT here (nobody is listening — the caller's failure
+#: accounting owns that), and neither is `ProtocolError` (deterministic).
+_TRANSIENT_RESETS = (ConnectionResetError, BrokenPipeError,
+                     ConnectionAbortedError)
 
 
 def send_json(sock: socket.socket, obj: Dict) -> None:
@@ -38,22 +59,42 @@ def recv_json(sock: socket.socket) -> Dict:
             raise ConnectionError("control peer closed mid-message")
         buf.extend(chunk)
         if len(buf) > MAX_LINE:
-            raise ConnectionError("control message exceeds MAX_LINE")
+            raise ProtocolError("control message exceeds MAX_LINE")
     return json.loads(buf.decode())
 
 
 def request(address: Tuple[str, int], obj: Dict,
-            timeout: float = 5.0) -> Dict:
-    """One request/response round trip on a fresh connection.
+            timeout: float = 5.0, retries: int = 1) -> Dict:
+    """One request/response round trip on a fresh connection, with a
+    per-request socket timeout on connect AND each send/recv.
 
-    Raises ``OSError`` (incl. ``ConnectionError``/``socket.timeout``)
-    when the peer is down/unreachable — the caller owns classification
-    (the elastic agent turns repeated failures into coordinator loss).
+    A transient mid-verb reset (``ECONNRESET``/``EPIPE``/peer closed
+    mid-message) gets ``retries`` immediate classified retries on a
+    fresh connection — previously it surfaced as a raw ``OSError`` with
+    no `Status` classification and no second chance, failing a
+    heartbeat for a one-packet hiccup.  Everything else still raises
+    ``OSError`` unchanged (incl. ``ConnectionRefusedError`` and
+    ``socket.timeout``) — the caller owns terminal classification (the
+    elastic agent turns repeated failures into coordinator loss).
     """
-    with socket.create_connection(address, timeout=timeout) as sock:
-        sock.settimeout(timeout)
-        send_json(sock, obj)
-        return recv_json(sock)
+    attempt = 0
+    while True:
+        try:
+            with socket.create_connection(address, timeout=timeout) as sock:
+                sock.settimeout(timeout)
+                send_json(sock, obj)
+                return recv_json(sock)
+        except ConnectionError as e:
+            transient = (isinstance(e, _TRANSIENT_RESETS)
+                         or type(e) is ConnectionError)
+            if not transient or attempt >= retries:
+                raise
+            attempt += 1
+            st = Status.from_exception(e)
+            obs_spans.instant("control.retry", attempt=attempt,
+                              code=st.code.name,
+                              error=f"{type(e).__name__}: {e}"[:120])
+            obs_metrics.counter_add("control.retries")
 
 
 class JsonServer:

@@ -59,6 +59,7 @@ _lock = threading.Lock()
 _rank: Optional[object] = None       # int rank, or "coord" on a coordinator
 _run_id: Optional[str] = None
 _clock: Optional["ClockInfo"] = None
+_incarnation: Optional[int] = None   # coordinator incarnation last seen
 _reasons: List[Dict[str, object]] = []   # terminal events this process saw
 
 
@@ -98,13 +99,29 @@ def current_run_id() -> Optional[str]:
     return str(config.knob("CYLON_TPU_RUN_ID")) or None
 
 
+def set_incarnation(inc: Optional[int]) -> None:
+    """Register the coordinator incarnation this process last observed
+    (the elastic agent calls this on every absorbed view): flight dumps
+    and the status tooling stamp it, so a post-mortem can tell which
+    coordinator lifetime an event belongs to."""
+    global _incarnation
+    with _lock:
+        _incarnation = None if inc is None else int(inc)
+
+
+def current_incarnation() -> Optional[int]:
+    with _lock:
+        return _incarnation
+
+
 def reset() -> None:
     """Clear identity, clock, and recorded terminal events (tests)."""
-    global _rank, _run_id, _clock
+    global _rank, _run_id, _clock, _incarnation
     with _lock:
         _rank = None
         _run_id = None
         _clock = None
+        _incarnation = None
         _reasons.clear()
         _last_write.clear()
 
@@ -282,6 +299,7 @@ def flight_record(reason: str, *, rank=None, run_id: Optional[str] = None,
             "attrs": entry["attrs"],
             "terminal_events": reasons,
             "clock": clock_dict(),
+            "incarnation": current_incarnation(),
             "traceEvents": [export_mod._event_json(e, pid)
                             for e in spans_mod.ring_events()],
             "ring_cap": spans_mod.ring_cap(),

@@ -73,16 +73,46 @@ def max_oom_splits() -> int:
 # retry policy
 # ---------------------------------------------------------------------------
 
+_U64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """splitmix64 finalizer on plain ints — the stateless hash behind
+    seeded full-jitter (no RNG object, no hidden state: ``(seed, i)``
+    always yields the same draw)."""
+    x = (x + 0x9E3779B97F4A7C15) & _U64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _U64
+    return x ^ (x >> 31)
+
+
+def _jitter_u01(seed: int, i: int) -> float:
+    """Deterministic uniform draw in [0, 1) for the ``i``-th retry under
+    ``seed``."""
+    return _splitmix64((seed & _U64) ^ _splitmix64(i)) / float(1 << 64)
+
+
 @dataclass
 class RetryPolicy:
     """Bounded exponential backoff for transient (`Code.ExecutionError`)
     failures.  ``max_retries`` is the number of RE-tries: an operation is
-    attempted at most ``max_retries + 1`` times."""
+    attempted at most ``max_retries + 1`` times.
+
+    ``jitter="full"`` draws each delay uniformly from ``[0, exp_delay]``
+    (AWS full-jitter): when MANY clients back off from the same event —
+    every survivor of a coordinator restart reconnecting at once — pure
+    exponential backoff keeps them in lockstep and the whole herd
+    thunders into the one-shot TCP accept loop on the same tick.  The
+    draw is seeded-deterministic per (seed, retry_index): give each
+    client a distinct ``jitter_seed`` (its rank) and the herd spreads,
+    while tests replay the exact same schedule."""
 
     max_retries: int = 2
     base_s: float = 0.05
     max_s: float = 2.0
     multiplier: float = 2.0
+    jitter: str = "none"            # "none" | "full"
+    jitter_seed: int = 0
     sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
 
     @classmethod
@@ -93,8 +123,19 @@ class RetryPolicy:
             max_s=max(0.0, float(config.knob("CYLON_TPU_RETRY_MAX_S"))))
 
     def delay(self, retry_index: int) -> float:
-        """Backoff before the ``retry_index``-th retry (0-based)."""
-        return min(self.base_s * (self.multiplier ** retry_index), self.max_s)
+        """Backoff before the ``retry_index``-th retry (0-based).  Safe
+        for unbounded indices (long reconnect loops): the exponential
+        saturates at ``max_s`` instead of overflowing, while the jitter
+        draw keeps advancing with the index — a capped draw would freeze
+        every late retry at one fixed per-seed delay."""
+        if retry_index >= 64:
+            d = self.max_s  # multiplier**i would overflow; it's capped
+        else:
+            d = min(self.base_s * (self.multiplier ** retry_index),
+                    self.max_s)
+        if self.jitter == "full":
+            return d * _jitter_u01(self.jitter_seed, retry_index)
+        return d
 
     def delays(self):
         for i in range(self.max_retries):
@@ -191,6 +232,21 @@ _KIND_MESSAGES = {
     "shed": ("UNAVAILABLE: injected shed at {site} (hit {hit}): "
              "request shed under load"),
     "cache_evict_race": "injected cache evict race at {site} (hit {hit})",
+    # control-plane survivability kinds (PR 11): `coordinator_restart`
+    # raises at the coordinator's detector probe, which catches it and
+    # restarts IN PLACE from the durable coordinator log — incarnation
+    # and epoch bump, same address (the crash + takeover the reconnect
+    # window must ride through); `coord_partition` raises at the agent's
+    # RPC probe, which converts it into a ConnectionError — control
+    # messages dropped one-way (agent -> coordinator) while the process
+    # keeps computing; `coord_slow` sleeps the coordinator's verb
+    # handler for CYLON_TPU_FAULT_DELAY_S and continues — delayed
+    # replies that stress RPC timeouts without any loss
+    "coordinator_restart": ("UNAVAILABLE: injected coordinator restart at "
+                            "{site} (hit {hit}): takeover in progress"),
+    "coord_partition": ("UNAVAILABLE: injected control partition at {site} "
+                        "(hit {hit}): packet dropped"),
+    "coord_slow": "injected slow control verb at {site} (hit {hit})",
 }
 
 FAULT_KINDS = tuple(_KIND_MESSAGES)
@@ -220,20 +276,37 @@ class FaultPlan:
     Deterministic by construction: a site's Nth hit either always fires
     or never does, independent of timing.  ``hits`` and ``fired`` are
     exposed so tests can assert a site was actually exercised.
-    """
 
-    def __init__(self, rules: List[_FaultRule], spec: str = ""):
+    Grammar extensions for chaos schedules (`FaultSchedule`): a
+    ``seed=<int>`` entry anywhere in the spec seeds the plan, and a hit
+    index may carry ``~J`` (``site@N~J=kind``) — the rule fires on a hit
+    drawn deterministically from ``[N, N+J]`` by the seed and the rule's
+    position, so one seed replays one exact multi-event timeline while
+    different seeds explore different interleavings."""
+
+    def __init__(self, rules: List[_FaultRule], spec: str = "",
+                 seed: int = 0):
         self.rules = rules
         self.spec = spec
+        self.seed = seed
         self.hits: Dict[str, int] = {}
         self.fired: List[Tuple[str, str, int]] = []  # (site, kind, hit)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
-        rules: List[_FaultRule] = []
+        raw_rules: List[Tuple[str, int, int, str, bool, str]] = []
+        seed = 0
         for raw in spec.replace(",", ";").split(";"):
             entry = raw.strip()
             if not entry:
+                continue
+            if entry.startswith("seed="):
+                try:
+                    seed = int(entry[len("seed="):])
+                except ValueError:
+                    raise CylonError(Code.Invalid,
+                                     f"bad seed in CYLON_TPU_FAULT_PLAN "
+                                     f"entry {raw!r}")
                 continue
             persistent = False
             kind = "oom"
@@ -248,9 +321,22 @@ class FaultPlan:
                                  f"bad fault kind {kind!r} in "
                                  f"CYLON_TPU_FAULT_PLAN entry {raw!r} "
                                  f"(expected one of {FAULT_KINDS})")
-            nth = 1
+            nth, jit = 1, 0
             if "@" in entry:
                 entry, n = entry.split("@", 1)
+                if "~" in n:
+                    n, j = n.split("~", 1)
+                    try:
+                        jit = int(j)
+                    except ValueError:
+                        raise CylonError(Code.Invalid,
+                                         f"bad hit jitter {j!r} in "
+                                         f"CYLON_TPU_FAULT_PLAN entry "
+                                         f"{raw!r}")
+                    if jit < 0:
+                        raise CylonError(Code.Invalid,
+                                         f"hit jitter must be >= 0 in "
+                                         f"{raw!r}")
                 try:
                     nth = int(n)
                 except ValueError:
@@ -265,8 +351,17 @@ class FaultPlan:
                 raise CylonError(Code.Invalid,
                                  f"empty site in CYLON_TPU_FAULT_PLAN "
                                  f"entry {raw!r}")
+            raw_rules.append((site, nth, jit, kind, persistent, raw))
+        rules: List[_FaultRule] = []
+        for idx, (site, nth, jit, kind, persistent, _raw) in \
+                enumerate(raw_rules):
+            if jit:
+                # the seed + rule position pick the exact hit: one spec
+                # string is one timeline, replayable byte-for-byte
+                nth += _splitmix64((seed & _U64) ^ _splitmix64(idx + 1)) \
+                    % (jit + 1)
             rules.append(_FaultRule(site, nth, kind, persistent))
-        return cls(rules, spec)
+        return cls(rules, spec, seed=seed)
 
     def check(self, site: str) -> Optional[str]:
         """Record one hit of ``site``; return the fault kind to raise, or
@@ -339,7 +434,7 @@ def fault_point(site: str) -> None:
 
             time.sleep(max(1.5 * durable.deadline_s(), 0.05))
             return
-        if kind == "delay":
+        if kind in ("delay", "coord_slow"):
             time.sleep(fault_delay_s())
             return
         raise InjectedFault(site, kind, plan.hits[site])
@@ -357,6 +452,62 @@ def fault_plan(spec: str):
         yield plan
     finally:
         _OVERRIDE_PLAN = prev
+
+
+class FaultSchedule:
+    """Composable, seeded multi-event chaos timeline.
+
+    A builder over the `FaultPlan` grammar: chain :meth:`at` calls to
+    compose any of the registered fault kinds — the elastic membership
+    kinds, the durable-execution kinds, and the control-plane kinds
+    ``coordinator_restart`` / ``coord_partition`` / ``coord_slow`` —
+    into one spec string that ``CYLON_TPU_FAULT_PLAN`` (a worker's
+    environment) or :meth:`install` (an in-process test) drives.  The
+    schedule's ``seed`` resolves every jittered hit index at parse
+    time, so a timeline is a pure function of (spec, seed): re-running
+    it replays the exact same event order, and sweeping seeds explores
+    different interleavings deterministically.
+
+        sched = (FaultSchedule(seed=11)
+                 .at("elastic.coordinator", "coordinator_restart", nth=2)
+                 .at("elastic.rpc.r1", "coord_partition", nth=3, jitter=4)
+                 .at("elastic.pass.r2", "delay", nth=1, persistent=True))
+        env["CYLON_TPU_FAULT_PLAN"] = sched.spec()
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._events: List[Tuple[str, str, int, int, bool]] = []
+
+    def at(self, site: str, kind: str, nth: int = 1, jitter: int = 0,
+           persistent: bool = False) -> "FaultSchedule":
+        """Add one event: fire ``kind`` on a hit of ``site`` drawn from
+        ``[nth, nth+jitter]`` by the schedule's seed.  Returns self for
+        chaining; validation happens through `FaultPlan.parse`."""
+        if kind not in _KIND_MESSAGES:
+            raise CylonError(Code.Invalid,
+                             f"bad fault kind {kind!r} in FaultSchedule "
+                             f"(expected one of {FAULT_KINDS})")
+        self._events.append((site, kind, int(nth), int(jitter),
+                             bool(persistent)))
+        return self
+
+    def spec(self) -> str:
+        """The composed ``CYLON_TPU_FAULT_PLAN`` spec string."""
+        parts = [f"seed={self.seed}"] if self.seed else []
+        for site, kind, nth, jitter, persistent in self._events:
+            at = f"@{nth}" + (f"~{jitter}" if jitter else "")
+            parts.append(f"{site}{at}{'+' if persistent else ''}={kind}")
+        return ";".join(parts)
+
+    def plan(self) -> FaultPlan:
+        """The parsed (jitter-resolved) plan this schedule compiles to."""
+        return FaultPlan.parse(self.spec())
+
+    def install(self):
+        """Context manager installing the schedule as the active fault
+        plan (tests); yields the `FaultPlan` for hit/fired asserts."""
+        return fault_plan(self.spec())
 
 
 def classify(exc: BaseException) -> Code:

@@ -692,6 +692,22 @@ class QueryService:
             self._lock.notify_all()
         self._thread.join(timeout=5.0)
 
+    # -- fleet integration --------------------------------------------------
+
+    def attach_to_agent(self, agent) -> "QueryService":
+        """Wire this service's :meth:`telemetry` onto an elastic agent's
+        heartbeats (``agent.attach_telemetry``).  One call is durable
+        across coordinator restarts: the callable lives on the AGENT, and
+        the agent's reconnect path pushes an immediate heartbeat after a
+        successful re-join, so a restarted coordinator's ``status`` verb
+        repopulates this service's queue depth and per-tenant SLO
+        histograms without waiting out a heartbeat interval — no
+        re-registration choreography on the serving side."""
+        agent.attach_telemetry(self.telemetry)
+        obs_spans.instant("serve.telemetry_attached", service=self.name,
+                          rank=getattr(agent, "rank", None))
+        return self
+
     # -- introspection ------------------------------------------------------
 
     def queue_depth(self) -> int:

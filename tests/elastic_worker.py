@@ -108,6 +108,7 @@ def main() -> int:
     with open(stats_path, "w", encoding="utf-8") as fh:
         json.dump({"rank": rank, "epoch": agent.epoch,
                    "members": list(agent.members),
+                   "incarnation": agent.incarnation,
                    **{k: v for k, v in stats.items()
                       if isinstance(v, (int, float, str, list))}}, fh)
     agent.leave()

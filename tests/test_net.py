@@ -36,6 +36,74 @@ def test_channel_callback_imports():
     assert buf.GetByteBuffer().dtype == np.uint8
 
 
+def test_control_request_retries_transient_reset_once():
+    """A mid-verb reset (the peer accepted, then tore the connection
+    down before replying) gets ONE classified retry on a fresh
+    connection; a healthy second accept serves the reply.  Refused
+    connections (nobody listening) are NOT retried here — the agent's
+    failure accounting owns those."""
+    import json
+    import socket
+    import threading
+
+    from cylon_tpu.net import control
+    from cylon_tpu.obs import metrics as obs_metrics
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    addr = srv.getsockname()[:2]
+
+    def serve():
+        # first connection: accept and slam shut mid-verb
+        conn, _ = srv.accept()
+        conn.close()
+        # second connection: a proper reply
+        conn, _ = srv.accept()
+        with conn:
+            conn.recv(4096)
+            conn.sendall(json.dumps({"ok": True, "n": 7}).encode() + b"\n")
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    before = obs_metrics.counter_value("control.retries")
+    try:
+        resp = control.request(addr, {"cmd": "ping"}, timeout=5.0)
+    finally:
+        srv.close()
+    t.join(5)
+    assert resp == {"ok": True, "n": 7}
+    assert obs_metrics.counter_value("control.retries") == before + 1
+
+
+def test_control_request_reset_twice_raises_oserror():
+    """The retry budget is one: a peer that resets BOTH attempts still
+    surfaces the raw OSError for the caller to classify."""
+    import socket
+    import threading
+
+    from cylon_tpu.net import control
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    addr = srv.getsockname()[:2]
+
+    def serve():
+        for _ in range(2):
+            conn, _ = srv.accept()
+            conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(OSError):
+            control.request(addr, {"cmd": "ping"}, timeout=5.0)
+    finally:
+        srv.close()
+    t.join(5)
+
+
 def test_byte_all_to_all_local():
     """Reference semantics: insert per-target buffers, finish, poll
     isComplete; receive callbacks fire with source + bytes + headers

@@ -8,6 +8,8 @@ contract for every recovery path: the recovered result equals the
 uninjected run's result (canonical row order), and the stats prove the
 stream RESUMED at the failure point instead of restarting.
 """
+import time
+
 import numpy as np
 import pytest
 
@@ -129,6 +131,33 @@ def test_retry_call_exhaustion_raises_classified():
     assert "probe" in ei.value.msg and "2 attempts" in ei.value.msg
 
 
+def test_retry_policy_full_jitter_seeded_deterministic():
+    """Full jitter draws each delay uniformly from [0, exp_delay],
+    deterministically per (seed, retry_index): same seed replays the
+    exact schedule, different seeds (= different ranks) spread — the
+    anti-thundering-herd property the coordinator reconnect path needs."""
+    p7 = RetryPolicy(max_retries=8, base_s=0.1, max_s=0.5, jitter="full",
+                     jitter_seed=7)
+    ds = [p7.delay(i) for i in range(8)]
+    # bounded by the undithered exponential envelope
+    plain = RetryPolicy(max_retries=8, base_s=0.1, max_s=0.5)
+    for i, d in enumerate(ds):
+        assert 0.0 <= d <= plain.delay(i)
+    # deterministic replay under the same seed
+    assert ds == [RetryPolicy(max_retries=8, base_s=0.1, max_s=0.5,
+                              jitter="full", jitter_seed=7).delay(i)
+                  for i in range(8)]
+    # distinct seeds give distinct schedules (the herd spreads)
+    ds9 = [RetryPolicy(max_retries=8, base_s=0.1, max_s=0.5,
+                       jitter="full", jitter_seed=9).delay(i)
+           for i in range(8)]
+    assert ds != ds9
+    # jitter off is the exact historical exponential sequence
+    none = RetryPolicy(max_retries=3, base_s=0.1, max_s=0.5)
+    assert list(none.delays()) == [pytest.approx(0.1), pytest.approx(0.2),
+                                   pytest.approx(0.4)]
+
+
 def test_retry_call_never_retries_bugs_or_oom():
     policy = RetryPolicy(max_retries=5, sleep=lambda s: None)
     calls = {"n": 0}
@@ -162,11 +191,80 @@ def test_fault_plan_parse_forms():
         ("c", 2, "comm", True)]
 
 
-@pytest.mark.parametrize("spec", ["x@1=lava", "x@zero", "x@0", "@2=oom"])
+@pytest.mark.parametrize("spec", ["x@1=lava", "x@zero", "x@0", "@2=oom",
+                                  "seed=pi;x@1=oom", "x@1~q=oom",
+                                  "x@1~-2=oom"])
 def test_fault_plan_rejects_bad_specs(spec):
     with pytest.raises(CylonError) as ei:
         FaultPlan.parse(spec)
     assert ei.value.code == Code.Invalid
+
+
+def test_fault_plan_seeded_hit_jitter_is_deterministic():
+    """`seed=S` + `@N~J`: the fired hit lands in [N, N+J], resolved at
+    parse time purely from (seed, rule position) — one spec string is
+    one replayable timeline, and sweeping seeds explores different
+    interleavings."""
+    spec = "seed=5;a@2~3=comm;b@1=oom"
+    p1, p2 = FaultPlan.parse(spec), FaultPlan.parse(spec)
+    assert [(r.site, r.nth, r.kind) for r in p1.rules] == \
+           [(r.site, r.nth, r.kind) for r in p2.rules]
+    (a, b) = p1.rules
+    assert 2 <= a.nth <= 5 and b.nth == 1  # unjittered rules untouched
+    # some seed in a small sweep picks a different hit (jitter is real)
+    nths = {FaultPlan.parse(f"seed={s};a@2~3=comm").rules[0].nth
+            for s in range(16)}
+    assert len(nths) > 1 and nths <= {2, 3, 4, 5}
+    # without a seed entry the jitter still resolves (seed defaults 0)
+    assert 2 <= FaultPlan.parse("a@2~3=comm").rules[0].nth <= 5
+
+
+def test_fault_schedule_composes_and_roundtrips():
+    """FaultSchedule chains events (the control-plane kinds included)
+    into a CYLON_TPU_FAULT_PLAN spec whose parse resolves to the same
+    timeline; install() drives fault_point like any plan."""
+    from cylon_tpu import resilience
+
+    sched = (resilience.FaultSchedule(seed=11)
+             .at("elastic.coordinator", "coordinator_restart", nth=2)
+             .at("elastic.rpc.r1", "coord_partition", nth=1, jitter=2,
+                 persistent=True)
+             .at("exec.pass", "delay", nth=1))
+    spec = sched.spec()
+    assert spec.startswith("seed=11;")
+    assert "coordinator_restart" in spec and "+=coord_partition" in spec
+    got = [(r.site, r.nth, r.kind, r.persistent)
+           for r in FaultPlan.parse(spec).rules]
+    want = [(r.site, r.nth, r.kind, r.persistent)
+            for r in sched.plan().rules]
+    assert got == want
+    assert got[0] == ("elastic.coordinator", 2, "coordinator_restart",
+                      False)
+    assert got[1][0] == "elastic.rpc.r1" and 1 <= got[1][1] <= 3 \
+        and got[1][3] is True
+    # unknown kinds rejected at composition time, not at fire time
+    with pytest.raises(CylonError):
+        resilience.FaultSchedule().at("x", "lava")
+    # install() makes it the active plan: coord_partition surfaces at
+    # the agent RPC probe as an InjectedFault the caller converts
+    with (resilience.FaultSchedule(seed=1)
+          .at("x", "comm", nth=1).install()) as plan:
+        with pytest.raises(InjectedFault):
+            fault_point("x")
+        assert plan.fired == [("x", "comm", 1)]
+
+
+def test_coord_slow_fault_kind_delays_and_continues(monkeypatch):
+    """coord_slow is a delayed reply, never a lost one: the probe sleeps
+    CYLON_TPU_FAULT_DELAY_S and returns."""
+    from cylon_tpu import config
+
+    with config.knob_env(CYLON_TPU_FAULT_DELAY_S="0.05"):
+        with fault_plan("verb@1=coord_slow") as plan:
+            t0 = time.monotonic()
+            fault_point("verb")  # no raise
+            assert time.monotonic() - t0 >= 0.05
+            assert plan.fired == [("verb", "coord_slow", 1)]
 
 
 def test_fault_point_fires_on_nth_hit_only():

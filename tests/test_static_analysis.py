@@ -295,6 +295,25 @@ def test_cy106_guarded_recovery_path_is_clean(tmp_path):
     assert found == []
 
 
+def test_cy106_covers_reconnect_paths(tmp_path):
+    """PR 11: reconnect/ride-through paths are recovery roots too — a
+    collective issued from a reconnected agent's path against a
+    possibly-restarted coordinator is the same stale-world hazard as one
+    issued from a resume path."""
+    found = _scan_elastic(tmp_path, """\
+        import jax
+
+        def _reconnect_loop(agent, x):
+            return jax.lax.psum(x, "p")
+
+        def _ride_out_window(agent, epoch, x):
+            agent.ensure_epoch(epoch)
+            return jax.lax.psum(x, "p")
+        """)
+    assert _rules_at(found) == [("CY106", 3)]
+    assert "psum" in found[0].msg  # the guarded ride_out path is clean
+
+
 def test_cy106_only_fires_in_the_elastic_module(tmp_path):
     # the same shape outside cylon_tpu.elastic is not a recovery path
     found = _scan(tmp_path, """\

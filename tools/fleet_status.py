@@ -57,7 +57,8 @@ def _hist_line(h: Dict) -> str:
 
 def render(st: Dict) -> str:
     lines = []
-    lines.append(f"epoch {st.get('epoch')}  members {st.get('members')}  "
+    lines.append(f"incarnation {st.get('incarnation', 0)}  "
+                 f"epoch {st.get('epoch')}  members {st.get('members')}  "
                  f"world {st.get('world')}")
     dead = st.get("dead") or {}
     if dead:

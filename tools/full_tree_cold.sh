@@ -160,6 +160,88 @@ if [ $rc -ne 0 ]; then
   echo "elastic kill-one-resume smoke failed (rc=$rc); fix elastic membership before the full tree" >&2
   exit $rc
 fi
+# coordinator-restart chaos smoke (ISSUE-11): a 3-process gang whose
+# coordinator is killed mid-pass and restarted from the durable
+# COORD_LOG at the same address — every worker must ride through its
+# reconnect window (incarnation 1 observed, epoch bumped once), resume
+# via the journal, and assemble a result bit-identical to the
+# single-process oracle; asserted from the artifact JSON — catches a
+# control-plane survivability regression in ~60 s, before the full tree
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import json, os, subprocess, sys, tempfile, time
+
+sys.path.insert(0, os.getcwd())
+import numpy as np
+from cylon_tpu import elastic
+from tests.elastic_worker import N_PASSES, inputs, run_op
+
+td = tempfile.mkdtemp(prefix="cylon_restart_smoke.")
+left, right = inputs(13)
+base, _ = run_op(left, right)
+order = np.argsort(base["l_k"], kind="stable")
+expected = {k: np.asarray(v)[order] for k, v in base.items()}
+
+coord_dir = os.path.join(td, "coord")
+coord = elastic.Coordinator(3, heartbeat_timeout_s=2.5,
+                            log_dir=coord_dir).start()
+base_env = {k: v for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS",
+                         "CYLON_TPU_FAULT_PLAN", "CYLON_TPU_DURABLE_DIR")}
+base_env.update(CYLON_TPU_DURABLE_DIR=os.path.join(td, "journal"),
+                CYLON_TPU_HEARTBEAT_S="0.1",
+                CYLON_TPU_HEARTBEAT_TIMEOUT_S="0.8",
+                CYLON_TPU_COORD_RECONNECT_S="30")
+addr = f"{coord.address[0]}:{coord.address[1]}"
+procs = [subprocess.Popen(
+    [sys.executable, "-m", "tests.elastic_worker", str(r), "3", addr,
+     os.path.join(td, f"out_r{r}.npz"),
+     os.path.join(td, f"stats_r{r}.json"), "13"],
+    env=dict(base_env)) for r in range(3)]
+coord2 = None
+try:
+    deadline = time.monotonic() + 60
+    while len(coord.view().members) < 3:
+        assert time.monotonic() < deadline, "gang never formed"
+        time.sleep(0.05)
+    time.sleep(0.3)            # let the run get under way
+    host, port = coord.address
+    coord.stop()               # kill -9 semantics: no goodbye
+    time.sleep(1.0)            # workers enter their reconnect windows
+    coord2 = elastic.Coordinator(3, heartbeat_timeout_s=2.5,
+                                 log_dir=coord_dir, host=host,
+                                 port=port).start()
+    assert coord2.restored and coord2.incarnation == 1, coord2.incarnation
+    for p in procs:
+        p.wait(timeout=240)
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    coord.stop()
+    if coord2 is not None:
+        coord2.stop()
+for r in range(3):
+    assert procs[r].returncode == 0, (r, procs[r].returncode)
+    got = dict(np.load(os.path.join(td, f"out_r{r}.npz"),
+                       allow_pickle=True))
+    for k in expected:
+        assert got[k].dtype == expected[k].dtype, k
+        np.testing.assert_array_equal(got[k], expected[k], err_msg=k)
+    stats = json.load(open(os.path.join(td, f"stats_r{r}.json")))
+    assert stats["incarnation"] == 1, stats
+    assert stats["epoch"] >= 1, stats
+    assert stats["passes_skipped"] == N_PASSES, stats
+print(f"coordinator-restart smoke ok: 3 workers rode through the "
+      f"restart (incarnation 1), bit-identical to oracle, "
+      f"{N_PASSES} journaled passes")
+import shutil; shutil.rmtree(td, ignore_errors=True)
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "coordinator-restart smoke failed (rc=$rc); fix the survivable control plane before the full tree" >&2
+  exit $rc
+fi
 # fleet-observability smoke (ISSUE-8): a 2-process elastic run with a
 # heartbeat_loss straggler (rank 1 goes silent AND drags a seeded delay)
 # must leave per-rank clock-aligned traces that trace_merge combines
