@@ -372,6 +372,39 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "membership changes stall — before CoordinatorLost fires "
             "(classified, Code.Unavailable).  0 reproduces the PR-6 "
             "fail-after-3-missed-ticks behavior exactly."),
+    _K("CYLON_TPU_PROFILE", "bool", False, RUNTIME,
+       accessors=("cylon_tpu.plan.profile.profiler_enabled",),
+       help="Query profiler: collect per-plan-node actuals (rows, self "
+            "time, exchange bytes, per-shard skew, cache hits) on every "
+            "plan.execute and export a plan_profile artifact beside the "
+            "traces (tools/trace_report.py --plan).  explain(analyze="
+            "True) forces one profiled run regardless.  Host-side only: "
+            "traced programs, cache keys and budget goldens are "
+            "identical either way; off (default) is the exact "
+            "pre-profiler code path."),
+    _K("CYLON_TPU_STATS_DIR", "str", "", RUNTIME,
+       accessors=("cylon_tpu.obs.stats_catalog.stats_dir",
+                  "cylon_tpu.obs.stats_catalog.enabled"),
+       help="Persistent statistics catalog root: profiled plan runs "
+            "append their observed per-scan column cardinality, join-"
+            "key selectivity and partition skew to an fsync'd "
+            "STATS.jsonl keyed by the plan content fingerprint, "
+            "reloadable across processes (optimizer.lookup_stats; "
+            "advisory-only — plans are bit-identical with or without "
+            "the catalog).  Empty (default) disables."),
+    _K("CYLON_TPU_STATS_CAP", "int", 256, RUNTIME,
+       accessors=("cylon_tpu.obs.stats_catalog.stats_cap",),
+       help="Distinct plan fingerprints the statistics catalog keeps: "
+            "past it STATS.jsonl compacts (atomic rewrite) to the most "
+            "recently written entries."),
+    _K("CYLON_TPU_METRICS_PORT", "int", 0, RUNTIME,
+       accessors=("cylon_tpu.obs.openmetrics.metrics_port",),
+       help="Per-process OpenMetrics scrape port: a tiny stdlib HTTP "
+            "listener answers GET /metrics with the obs.metrics "
+            "snapshot in Prometheus text exposition format (counters, "
+            "gauges, cumulative le-bucket histograms), started when the "
+            "first CylonContext initializes.  0 (default) disables; a "
+            "failed bind warns and skips, never fails the context."),
     _K("CYLON_TPU_DEBUG", "bool", False, RUNTIME,
        help="Log every span's duration at INFO (cylon_tpu.obs.spans; the "
             "utils.timing shim's historical switch)."),

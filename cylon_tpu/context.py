@@ -166,6 +166,12 @@ class CylonContext:
                 rank = (config.process_id
                         if config.process_id is not None else 0)
                 self._elastic_agent = elastic.connect(rank)
+        # OpenMetrics scrape listener (CYLON_TPU_METRICS_PORT): knob-
+        # driven, once per process, no-op at 0; a failed bind warns
+        # inside ensure_server and must never fail context bring-up
+        from .obs import openmetrics
+
+        openmetrics.ensure_server()
 
     # -- reference-parity static factories (ctx/cylon_context.cpp:25-43) ----
     @staticmethod

@@ -465,6 +465,7 @@ class Coordinator:
         self._barriers: Dict[Tuple[str, int], Dict[int, int]] = {}
         self._clocks: Dict[int, Dict] = {}       # rank -> offset/uncertainty
         self._telemetry: Dict[int, Dict] = {}    # rank -> serve telemetry
+        self._metrics: Dict[int, Dict] = {}      # rank -> metrics snapshot
         self._skews: "deque[Dict]" = deque(maxlen=64)
         self._pending_flight: List[Tuple[str, Dict]] = []  # staged dumps
         self._pending_log: List[Dict] = []       # staged CoordLog records
@@ -614,6 +615,7 @@ class Coordinator:
         # fleet view forever
         self._clocks.pop(rank, None)
         self._telemetry.pop(rank, None)
+        self._metrics.pop(rank, None)
         self._dead[rank] = reason
         self._epoch += 1
         # the fence + epoch bump is durable state: a coordinator that
@@ -808,6 +810,7 @@ class Coordinator:
                 self._barriers.clear()   # pending arrivals died with the
                 self._clocks.clear()     # old incarnation; latches are
                 self._telemetry.clear()  # durable
+                self._metrics.clear()
                 self.stale = False
                 self.died = False
                 self.restored = True
@@ -862,6 +865,33 @@ class Coordinator:
                         "error": "superseded coordinator incarnation"}
             return {"ok": True, "t_recv": t_recv,
                     "t_send": time.perf_counter_ns()}
+        if cmd == "metrics":
+            # fleet-wide OpenMetrics: the per-rank snapshots heartbeats
+            # ship (live members only — a dead rank's metrics left with
+            # its telemetry) plus this coordinator's own registry.  The
+            # lock hold is ONE dict copy; the multi-rank string render
+            # runs outside it (a Prometheus scrape must never stall
+            # heartbeats), which is safe because heartbeat handling
+            # REPLACES a rank's snapshot wholesale, never mutates it.
+            # One representation per reply: the exposition text by
+            # default, raw snapshots under `raw` (fleet_status --json)
+            # — shipping both doubled every scrape.
+            with self._lock:
+                if self.stale:
+                    return {"ok": False, "status": "stale_coordinator",
+                            "incarnation": self.incarnation,
+                            "error": "superseded coordinator incarnation"}
+                snaps: Dict[str, Dict] = {
+                    str(r): m for r, m in sorted(self._metrics.items())}
+                view = self._view_locked()
+            snaps["coord"] = obs_metrics.snapshot()
+            if req.get("raw"):
+                return {"ok": True, "ranks": snaps, **view}
+            from .obs import openmetrics
+
+            return {"ok": True,
+                    "openmetrics": openmetrics.render_fleet(snaps),
+                    **view}
         with self._lock:
             # incarnation fencing, coordinator side, under the SAME lock
             # hold as the verb dispatch below (one acquisition, and the
@@ -943,6 +973,9 @@ class Coordinator:
                 tel = req.get("telemetry")
                 if isinstance(tel, dict):
                     self._telemetry[rank] = tel
+                m = req.get("metrics")
+                if isinstance(m, dict):
+                    self._metrics[rank] = m
                 return {"ok": True, **self._view_locked()}
             if cmd == "barrier":
                 name, epoch = str(req.get("name")), req.get("epoch")
@@ -1018,6 +1051,19 @@ class Agent:
     #: dead — one lost packet must not fail a run
     MAX_RPC_FAILURES = 3
 
+    #: largest metrics snapshot a heartbeat will carry (the control
+    #: line is capped at net/control.MAX_LINE; the beat must fit with
+    #: telemetry + clock beside the snapshot)
+    METRICS_MAX_BYTES = 256 * 1024
+
+    #: ship the metrics snapshot on every Nth beat only: serializing a
+    #: busy process's registry (hundreds of histogram entries) per beat
+    #: is pure overhead at scrape granularity — a snapshot a couple of
+    #: heartbeat intervals old is exactly as good to Prometheus, and
+    #: the beat itself must stay cheap (GIL-starved beats read as
+    #: silence and fence the rank)
+    METRICS_EVERY_BEATS = 4
+
     def __init__(self, address, rank: int,
                  interval_s: Optional[float] = None,
                  timeout_s: Optional[float] = None,
@@ -1061,6 +1107,7 @@ class Agent:
         self._thread: Optional[threading.Thread] = None
         self.clock: Optional[obs_fleet.ClockInfo] = None
         self._telemetry_fn: Optional[Callable[[], Dict]] = None
+        self._beat_n = 0  # metrics ship every METRICS_EVERY_BEATS
 
     # -- lifecycle -------------------------------------------------------
 
@@ -1226,6 +1273,31 @@ class Agent:
             except Exception as e:  # telemetry must never kill the beat
                 log.debug("elastic: rank %d telemetry fn failed: %s: %s",
                           self.rank, type(e).__name__, e)
+        # metrics snapshot for the coordinator's fleet-wide OpenMetrics
+        # verb, shipped every METRICS_EVERY_BEATS beats (first beat
+        # included).  Size-guarded — a pathological registry must cost
+        # the METRICS, never the beat (an oversized line trips
+        # net/control's MAX_LINE and the rank reads as dead).  The
+        # guard serializes with the SAME strict encoder the wire uses
+        # (no default=): a registry value only a lenient encoder could
+        # handle must be caught HERE, where it costs the metrics, not
+        # later in control.request where the TypeError would escape
+        # _beat's OSError handling and kill the heartbeat thread.
+        ship = self._beat_n % max(1, self.METRICS_EVERY_BEATS) == 0
+        self._beat_n += 1
+        if ship:
+            try:
+                m = obs_metrics.snapshot()
+                if len(json.dumps(m, sort_keys=True)) \
+                        <= self.METRICS_MAX_BYTES:
+                    obj["metrics"] = m
+                else:
+                    log.debug("elastic: rank %d metrics snapshot over %d "
+                              "bytes; omitted from heartbeat", self.rank,
+                              self.METRICS_MAX_BYTES)
+            except Exception as e:  # accounting must never kill the beat
+                log.debug("elastic: rank %d metrics snapshot failed: "
+                          "%s: %s", self.rank, type(e).__name__, e)
         return obj
 
     def _absorb(self, resp: Dict) -> None:
@@ -1399,6 +1471,19 @@ class Agent:
                 self._reconnecting = False
 
     # -- views + guards --------------------------------------------------
+
+    def status(self) -> Optional[Dict]:
+        """One read-only ``status`` verb round trip — the coordinator's
+        fleet view (per-rank heartbeat ages, clock offsets, the recent
+        per-collective skew ledger, serve aggregate).  None when the
+        coordinator is unreachable or the reply is not ok; never
+        raises (consumers are observability paths — the query profiler
+        attaches the skew ledger with this)."""
+        try:
+            resp = self._rpc({"cmd": "status"})
+        except (OSError, ValueError):
+            return None
+        return resp if resp.get("ok") else None
 
     def view(self) -> MemberView:
         with self._lock:

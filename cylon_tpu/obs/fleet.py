@@ -205,11 +205,20 @@ def merge_hist(a: Optional[Dict], b: Optional[Dict]) -> Optional[Dict]:
     buckets: Dict[str, int] = dict(a.get("buckets") or {})
     for k, v in (b.get("buckets") or {}).items():
         buckets[k] = buckets.get(k, 0) + int(v)
-    return {"count": int(a.get("count", 0)) + int(b.get("count", 0)),
-            "sum": float(a.get("sum", 0.0)) + float(b.get("sum", 0.0)),
-            "min": min(mins) if mins else None,
-            "max": max(maxs) if maxs else None,
-            "buckets": {k: buckets[k] for k in sorted(buckets, key=int)}}
+    # the fixed cumulative le buckets sum per boundary (both sides share
+    # the metrics.LE_BUCKETS boundary set, so cumulative counts add)
+    le: Dict[str, int] = dict(a.get("le") or {})
+    for k, v in (b.get("le") or {}).items():
+        le[k] = le.get(k, 0) + int(v)
+    out = {"count": int(a.get("count", 0)) + int(b.get("count", 0)),
+           "sum": float(a.get("sum", 0.0)) + float(b.get("sum", 0.0)),
+           "min": min(mins) if mins else None,
+           "max": max(maxs) if maxs else None,
+           "buckets": {k: buckets[k] for k in sorted(buckets, key=int)}}
+    if le:
+        out["le"] = {k: le[k] for k in sorted(
+            le, key=lambda s: float("inf") if s == "+Inf" else float(s))}
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,7 @@ recording the same work in any order serialize identically.
 """
 from __future__ import annotations
 
+import bisect
 import sys
 from typing import Dict, Optional
 
@@ -25,12 +26,30 @@ _gauges: Dict[str, float] = {}
 _hists: Dict[str, "_Hist"] = {}
 
 
+#: fixed cumulative-bucket boundaries (OpenMetrics ``le`` semantics): a
+#: 1-2.5-5 ladder through 1e6, decades beyond (the >1e6 range is byte
+#: counts where decade resolution suffices) — wide enough to cover both
+#: millisecond latencies and byte counts with ONE boundary set, and
+#: FIXED so histograms recorded by different ranks (or different runs)
+#: merge by plain per-key addition (``fleet.merge_hist``) and render as
+#: Prometheus cumulative buckets without rebinning.  Changing this set
+#: breaks merges against already-persisted snapshots (flight dumps,
+#: heartbeat ledgers) — extend only with a version bump.
+LE_BUCKETS: tuple = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+                     5000, 10000, 25000, 50000, 100000, 250000, 500000,
+                     1000000, 10000000, 100000000, 1000000000)
+
+
 class _Hist:
     """Fixed-shape histogram: count/sum/min/max plus power-of-two bucket
     counts (bucket i holds values in [2**i, 2**(i+1)); negatives and
-    zeros land in bucket 0)."""
+    zeros land in bucket 0).  ``as_dict`` additionally emits the fixed
+    CUMULATIVE ``le`` buckets (``LE_BUCKETS`` + "+Inf") the OpenMetrics
+    exposition needs — per-boundary counts are kept non-cumulative
+    internally (one increment per observe) and accumulated at snapshot
+    time."""
 
-    __slots__ = ("count", "sum", "min", "max", "buckets")
+    __slots__ = ("count", "sum", "min", "max", "buckets", "le_counts")
 
     def __init__(self):
         self.count = 0
@@ -38,6 +57,8 @@ class _Hist:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.buckets: Dict[int, int] = {}
+        # one slot per LE_BUCKETS boundary + the +Inf overflow slot
+        self.le_counts = [0] * (len(LE_BUCKETS) + 1)
 
     def observe(self, value: float) -> None:
         v = float(value)
@@ -47,12 +68,26 @@ class _Hist:
         self.max = v if self.max is None else max(self.max, v)
         b = max(0, int(v).bit_length() - 1) if v >= 1 else 0
         self.buckets[b] = self.buckets.get(b, 0) + 1
+        i = bisect.bisect_left(LE_BUCKETS, v)
+        self.le_counts[i] += 1
+
+    def le_dict(self) -> Dict[str, int]:
+        """Cumulative {boundary: count of observations <= boundary},
+        keys are decimal strings plus "+Inf" (== count)."""
+        out: Dict[str, int] = {}
+        acc = 0
+        for bound, n in zip(LE_BUCKETS, self.le_counts):
+            acc += n
+            out[str(bound)] = acc
+        out["+Inf"] = acc + self.le_counts[-1]
+        return out
 
     def as_dict(self) -> Dict[str, object]:
         return {"count": self.count, "sum": self.sum,
                 "min": self.min, "max": self.max,
                 "buckets": {str(k): self.buckets[k]
-                            for k in sorted(self.buckets)}}
+                            for k in sorted(self.buckets)},
+                "le": self.le_dict()}
 
 
 def counter_add(name: str, value: float = 1) -> None:

@@ -11,6 +11,8 @@ filters and derived columns require.
 from .executor import execute, planner_enabled, run_service
 from .expr import Expr, col, lit
 from .ir import LogicalPlan
+from .profile import PlanProfile, profiler_enabled
 
 __all__ = ["LogicalPlan", "Expr", "col", "lit", "execute",
-           "planner_enabled", "run_service"]
+           "planner_enabled", "run_service", "PlanProfile",
+           "profiler_enabled"]

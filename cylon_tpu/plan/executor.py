@@ -24,15 +24,19 @@ device passes (``plan.cache_hit``; serve op ``"plan"``).
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .. import config, durable
+from ..obs import fleet as obs_fleet
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
-from ..status import Code, CylonError
+from ..obs import stats_catalog
+from ..status import Code, CylonError, Status
 from . import ir, optimizer
+from . import profile as profile_mod
 
 
 def planner_enabled() -> bool:
@@ -49,11 +53,19 @@ def planner_enabled() -> bool:
 
 
 def execute(plan: "ir.LogicalPlan", ctx=None, pass_guard=None,
-            stats_out: Optional[dict] = None):
+            stats_out: Optional[dict] = None,
+            profile: Optional["profile_mod.PlanProfile"] = None):
     """Run the plan, returning a Table.  With ``CYLON_TPU_DURABLE_DIR``
     set the run is journaled at plan granularity; a repeated fingerprint
     is served entirely from spill (a LOCAL 1-shard table — zero
-    compiles, zero device passes)."""
+    compiles, zero device passes).
+
+    ``profile=`` (or the ``CYLON_TPU_PROFILE`` knob) collects per-node
+    actuals into a :class:`~cylon_tpu.plan.profile.PlanProfile` — the
+    EXPLAIN ANALYZE substrate — and, with ``CYLON_TPU_STATS_DIR`` set,
+    persists the observed statistics to the catalog under the plan
+    fingerprint.  All host-side: the traced programs and their cache
+    keys are identical with the profiler on or off."""
     from ..table import Table
 
     ctx = ctx if ctx is not None else plan._ctx()
@@ -65,10 +77,19 @@ def execute(plan: "ir.LogicalPlan", ctx=None, pass_guard=None,
     enabled = planner_enabled()
     stats = stats_out if stats_out is not None else {}
     stats.update(passes=1, passes_skipped=0, parts_run=0)
+    prof = profile
+    if prof is None and profile_mod.profiler_enabled():
+        prof = profile_mod.PlanProfile()
 
+    fp: Optional[str] = None
     journal = None
-    if durable.enabled():
+    if durable.enabled() or (prof is not None and stats_catalog.enabled()):
         fp = plan.fingerprint()
+    if prof is not None:
+        prof.fingerprint = fp
+        if fp is not None and stats_catalog.enabled():
+            prof.estimates = stats_catalog.lookup(fp)
+    if durable.enabled():
         journal = durable.open_run(fp, "plan", world=world)
         if journal is not None and journal.is_complete():
             got = journal.load_pass(0, 0)
@@ -78,22 +99,53 @@ def execute(plan: "ir.LogicalPlan", ctx=None, pass_guard=None,
                 obs_spans.instant("plan.cache_hit", fingerprint=fp[:12],
                                   rows=rows)
                 stats.update(passes_skipped=1, rows=rows, cache_hit=True)
+                if prof is not None:
+                    prof.plan_cache_hit = True
+                    prof.finalize(optimizer.optimize(plan, enabled=enabled),
+                                  0)
+                    prof.export()
                 from ..context import CylonContext
 
                 return Table.from_numpy(list(frame), list(frame.values()),
                                         ctx=CylonContext.Init())
 
-    with obs_spans.span("plan.optimize", world=world, enabled=enabled):
-        phys = optimizer.optimize(plan, enabled=enabled)
-    if enabled:
-        obs_metrics.counter_add("plan.shuffles_elided",
-                                phys.shuffles_elided)
-        obs_metrics.counter_add("plan.columns_pruned", phys.columns_pruned)
-    with obs_spans.span("plan.execute", world=world, nodes=phys.nodes,
-                        elided=phys.shuffles_elided,
-                        pruned=phys.columns_pruned, optimized=enabled):
-        result = _Executor(plan, phys, ctx, pass_guard).run()
+    t_run0 = time.perf_counter_ns()
+    try:
+        with obs_spans.span("plan.optimize", world=world, enabled=enabled):
+            phys = optimizer.optimize(plan, enabled=enabled)
+        if enabled:
+            obs_metrics.counter_add("plan.shuffles_elided",
+                                    phys.shuffles_elided)
+            obs_metrics.counter_add("plan.columns_pruned",
+                                    phys.columns_pruned)
+        with obs_spans.span("plan.execute", world=world, nodes=phys.nodes,
+                            elided=phys.shuffles_elided,
+                            pruned=phys.columns_pruned, optimized=enabled):
+            result = _Executor(plan, phys, ctx, pass_guard, prof).run()
+    except Exception as e:
+        # planner-path terminal failure: dump the flight recorder like
+        # exec/serve/elastic terminal events already do, so the
+        # post-mortem exists even when tracing was never armed.  NOT
+        # terminal: a pass_guard's EpochMismatch is an ordinary elastic
+        # resume (elastic_run catches it and re-derives), and Cancelled
+        # is a deliberate caller action — dumping "plan_fatal" for
+        # those would litter every membership change / cancel with
+        # misleading fatal post-mortems (exec.py's fatal() draws the
+        # same line)
+        st = Status.from_exception(e)
+        if st.code not in (Code.EpochMismatch, Code.Cancelled):
+            obs_fleet.flight_record(
+                "plan_fatal", code=st.code.name,
+                fingerprint=fp[:12] if fp else None, world=world,
+                error=f"{type(e).__name__}: {e}"[:200])
+        raise
     stats.update(parts_run=1, rows=result.row_count, cache_hit=False)
+    if prof is not None:
+        prof.finalize(phys, time.perf_counter_ns() - t_run0)
+        prof.attach_fleet_skew(ctx)
+        if fp is not None and stats_catalog.enabled():
+            stats_catalog.record(fp, prof.catalog_record(plan))
+        prof.export()
 
     if journal is not None:
         frame = result.to_numpy()
@@ -122,12 +174,14 @@ def run_service(plan: "ir.LogicalPlan", *, ctx=None, pass_guard=None,
 
 
 class _Executor:
-    def __init__(self, plan, phys: optimizer.PhysPlan, ctx, pass_guard):
+    def __init__(self, plan, phys: optimizer.PhysPlan, ctx, pass_guard,
+                 profile: Optional["profile_mod.PlanProfile"] = None):
         self.plan = plan
         self.phys = phys
         self.ctx = ctx
         self.world = phys.world
         self.pass_guard = pass_guard
+        self.profile = profile
 
     def run(self):
         return self._exec(self.phys.root)
@@ -138,6 +192,20 @@ class _Executor:
 
     # -- generic dispatch ------------------------------------------------
     def _exec(self, p: optimizer.Phys):
+        prof = self.profile
+        if prof is None:
+            return self._exec_node(p)
+        # profiled: two clock reads + a handful of counter reads around
+        # the node, plus one row-count fetch of the ALREADY-materialized
+        # result — the node's subtree deltas; finalize() subtracts
+        # recorded descendants for self values.  Nothing traced changes.
+        before = profile_mod.counters_now()
+        t0 = time.perf_counter_ns()
+        t = self._exec_node(p)
+        prof.record_node(p, t, time.perf_counter_ns() - t0, before)
+        return t
+
+    def _exec_node(self, p: optimizer.Phys):
         n = p.node
         if isinstance(n, ir.Scan):
             return self._project_to(self.plan.inputs[n.idx], p.keep)
@@ -209,7 +277,22 @@ class _Executor:
 
     def _exec_chain(self, p: optimizer.Phys, keep: Tuple[str, ...]):
         """Execute a pure scan chain with an overridden column set (the
-        shared-scan rule's union keep)."""
+        shared-scan rule's union keep).  Profiled like ``_exec`` — a
+        self-join CSE'd by the shared-scan rule must still feed scan
+        cardinality and filter selectivity to the catalog (the chain
+        runs ONCE for both sides, so records land on the LEFT child's
+        subtree; the right twin stays unannotated)."""
+        prof = self.profile
+        if prof is None:
+            return self._exec_chain_node(p, keep)
+        before = profile_mod.counters_now()
+        t0 = time.perf_counter_ns()
+        t = self._exec_chain_node(p, keep)
+        if p.nid not in prof.nodes:
+            prof.record_node(p, t, time.perf_counter_ns() - t0, before)
+        return t
+
+    def _exec_chain_node(self, p: optimizer.Phys, keep: Tuple[str, ...]):
         n = p.node
         if isinstance(n, ir.Scan):
             t = self.plan.inputs[n.idx]
@@ -378,6 +461,11 @@ class _Executor:
             counts = _shard_wise(ctx, count_fn, lt, rt,
                                  key=("plan_join_count", stage_spec))
             out_cap = _cap_round(max(1, int(jnp.max(counts))))
+        if self.profile is not None:
+            # the fused join never materializes, but the exact count
+            # pass that sizes it IS its observed cardinality — record
+            # it so join selectivity reaches the statistics catalog
+            self.profile.record_fused_join(jphys, counts)
 
         # the aggregate's partial/final split mirrors distributed_groupby
         # exactly (bit-identity with the eager path); 1-shard worlds run

@@ -341,12 +341,30 @@ class LogicalPlan:
 
         return executor.execute(self, ctx=ctx)
 
-    def explain(self, optimized: Optional[bool] = None) -> str:
+    def explain(self, optimized: Optional[bool] = None,
+                analyze: bool = False) -> str:
         """Pretty-print the (optimized) plan: stages, elided shuffles,
-        pruned columns, plane widths.  Pure host-side — nothing runs."""
+        pruned columns, plane widths.  Pure host-side — nothing runs —
+        UNLESS ``analyze=True`` (EXPLAIN ANALYZE): the plan executes
+        once with the profiler on and every node line gains an
+        estimate→actual suffix (rows, self time, exchange bytes,
+        per-shard skew; estimates from the statistics catalog when a
+        prior run observed this plan)."""
         from . import explain as explain_mod
 
-        return explain_mod.explain(self, optimized=optimized)
+        return explain_mod.explain(self, optimized=optimized,
+                                   analyze=analyze)
+
+    def profile(self, ctx=None):
+        """Execute once with the profiler on; returns ``(Table,
+        PlanProfile)`` — the programmatic EXPLAIN ANALYZE surface
+        (per-node rows/bytes/skew as data instead of rendered text)."""
+        from . import executor
+        from . import profile as profile_mod
+
+        prof = profile_mod.PlanProfile()
+        t = executor.execute(self, ctx=ctx, profile=prof)
+        return t, prof
 
     def fingerprint(self) -> str:
         """Plan-granularity content fingerprint: op spec chain × world ×

@@ -48,13 +48,19 @@ Partitioning = Tuple[str, Tuple[Tuple[str, ...], ...], int]
 @dataclass
 class Phys:
     """One physical node: the logical node + pruning/shuffle/fusion
-    annotations the executor and explain() consume."""
+    annotations the executor and explain() consume.  ``nid`` is the
+    stable preorder id :func:`optimize` assigns — the profiler
+    (``plan/profile.py``) and the statistics catalog key per-node
+    actuals by it, so estimate lookups from a prior run line up
+    node-for-node (the numbering is a pure function of the plan tree
+    and the enabled flag)."""
 
     node: ir.Node
     children: List["Phys"] = field(default_factory=list)
     keep: Tuple[str, ...] = ()
     part: Optional[Partitioning] = None
     ann: Dict[str, object] = field(default_factory=dict)
+    nid: int = -1
 
 
 @dataclass
@@ -117,7 +123,40 @@ def optimize(plan: "ir.LogicalPlan", enabled: bool = True) -> PhysPlan:
     if enabled:
         _rule_fuse_local(out.root, world, out)
     out.nodes = _count(out.root)
+    _assign_nids(out.root, 0)
     return out
+
+
+def _assign_nids(p: Phys, next_id: int) -> int:
+    """Stable preorder node ids: the profiler/statistics-catalog key.
+    Deterministic per (plan tree, enabled), so two optimizations of the
+    same plan — this process's or a prior run's — number identically."""
+    p.nid = next_id
+    next_id += 1
+    for c in p.children:
+        next_id = _assign_nids(c, next_id)
+    return next_id
+
+
+def lookup_stats(plan) -> Optional[dict]:
+    """ADVISORY observed-statistics lookup for this exact plan: the
+    persistent catalog record a prior profiled run left under the
+    plan's content fingerprint (per-scan column cardinality, join-key
+    selectivity, per-node rows/skew), or None when the catalog is
+    disabled or has never seen the plan.
+
+    Deliberately NOT consulted by :func:`optimize` this PR — plans are
+    bit-identical with the catalog present or absent (tests pin it);
+    this is the feed the ROADMAP-1 cost model (broadcast joins, skew
+    salting, shuffle-vs-broadcast choice) will steer on.  Note the
+    fingerprint hashes pruned input CONTENT, so the lookup costs one
+    host gather of the scan columns — call it on planning/profiling
+    paths, not per-row hot paths."""
+    from ..obs import stats_catalog
+
+    if not stats_catalog.enabled():
+        return None
+    return stats_catalog.lookup(plan.fingerprint())
 
 
 def scan_prunes(phys: PhysPlan) -> List[Tuple[ir.Scan, Tuple[str, ...]]]:

@@ -52,7 +52,7 @@ def build_plan(cust, orde, line, nati):
 
 def run(sf: float = 0.01, world: int | None = None, seed: int = 0,
         check: bool = True, compare_eager: bool = False,
-        explain: bool = False) -> dict:
+        explain: bool = False, analyze: bool = False) -> dict:
     from cylon_tpu import config
     from cylon_tpu.obs import metrics as obs_metrics
 
@@ -74,6 +74,11 @@ def run(sf: float = 0.01, world: int | None = None, seed: int = 0,
     plan = build_plan(cust, orde, line, nati)
     if explain:
         print(plan.explain())
+    if analyze:
+        # EXPLAIN ANALYZE: one profiled execution with per-node
+        # estimate->actual annotations (rows, self time, exchange
+        # bytes, shard skew); the timed run below is unprofiled
+        print(plan.explain(analyze=True))
 
     elided0 = obs_metrics.counter_value("plan.shuffles_elided")
     t0 = time.perf_counter()
@@ -134,4 +139,5 @@ if __name__ == "__main__":
 
     sf = float(sys.argv[1]) if len(sys.argv) > 1 else 0.01
     run(sf, compare_eager="--compare-eager" in sys.argv,
-        explain="--explain" in sys.argv)
+        explain="--explain" in sys.argv,
+        analyze="--analyze" in sys.argv)

@@ -1,0 +1,684 @@
+"""ISSUE-12: the query profiler — per-plan-node EXPLAIN ANALYZE actuals,
+the persistent statistics catalog (fingerprint-keyed, torn-tail
+tolerant, LRU-capped, advisory-only), OpenMetrics rendering/scraping
+(cumulative le buckets, tenant labels, fleet render), the coordinator
+``metrics`` verb, the planner-path flight dump, and the tooling
+satellites (trace_report --plan / bytes_saved, fleet_status
+--openmetrics / --max-reply-bytes)."""
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cylon_tpu import Table, config
+from cylon_tpu.obs import fleet as obs_fleet
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.obs import openmetrics, stats_catalog
+from cylon_tpu.plan import PlanProfile, col, lit
+from cylon_tpu.plan import executor as plan_executor
+from cylon_tpu.plan import optimizer as plan_optimizer
+from cylon_tpu.status import CylonError
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _tables(ctx, rng, n=400, nkeys=24):
+    d = {"k": rng.integers(0, nkeys, n).astype(np.int32),
+         "v": rng.random(n).astype(np.float32),
+         "w": rng.random(n).astype(np.float32)}
+    t = Table.from_numpy(list(d), list(d.values()), ctx=ctx)
+    d2 = {"k2": rng.integers(0, nkeys, n).astype(np.int32),
+          "u": rng.random(n).astype(np.float32)}
+    t2 = Table.from_numpy(list(d2), list(d2.values()), ctx=ctx)
+    return d, t, d2, t2
+
+
+def _q(t, t2):
+    return (t.plan().filter(col("v") > lit(0.2))
+            .join(t2.plan(), left_on="k", right_on="k2")
+            .groupby(["k"], {"u": ["sum"]}))
+
+
+# ---------------------------------------------------------------------------
+# histogram le buckets (satellite: metrics.py)
+# ---------------------------------------------------------------------------
+
+
+def test_hist_le_buckets_cumulative_and_merge():
+    h = obs_metrics._Hist()
+    for v in (0.5, 1.0, 3.0, 70.0, 900.0, 1e6, 5e9):
+        h.observe(v)
+    d = h.as_dict()
+    # pre-existing consumers' shape is untouched
+    assert d["count"] == 7 and d["min"] == 0.5 and d["max"] == 5e9
+    le = d["le"]
+    assert le["1"] == 2          # 0.5 and 1.0 (le is <=)
+    assert le["5"] == 3
+    assert le["100"] == 4
+    assert le["1000"] == 5
+    assert le["1000000"] == 6
+    assert le["1000000000"] == 6  # 5e9 only in +Inf
+    assert le["+Inf"] == d["count"]
+    vals = list(le.values())
+    assert vals == sorted(vals), "cumulative buckets must be monotone"
+    # merge: cumulative counts add per boundary (same fixed boundaries)
+    m = obs_fleet.merge_hist(d, d)
+    assert m["count"] == 14
+    assert m["le"]["1"] == 4 and m["le"]["+Inf"] == 14
+    assert m["le"]["+Inf"] == m["count"]
+
+
+def test_hist_le_merge_with_legacy_hist():
+    # a foreign/legacy hist dict without le still merges (slo view)
+    legacy = {"count": 2, "sum": 3.0, "min": 1.0, "max": 2.0,
+              "buckets": {"0": 2}}
+    new = obs_metrics._Hist()
+    new.observe(4.0)
+    m = obs_fleet.merge_hist(legacy, new.as_dict())
+    assert m["count"] == 3 and m["le"]["+Inf"] == 1
+
+
+# ---------------------------------------------------------------------------
+# openmetrics render / parse / scrape
+# ---------------------------------------------------------------------------
+
+
+def test_openmetrics_render_matches_snapshot_and_parses():
+    snap = {"counters": {"shuffle.bytes_sent": 123,
+                         "serve.admitted": 4},
+            "gauges": {"elastic.epoch": 2.0},
+            "histograms": {}}
+    h = obs_metrics._Hist()
+    for v in (3.0, 900.0):
+        h.observe(v)
+    snap["histograms"]["serve.run_ms[acme]"] = h.as_dict()
+    text = openmetrics.render(snap)
+    doc = openmetrics.parse(text)
+    c = doc["cylon_tpu_shuffle_bytes_sent_total"]
+    assert c["type"] == "counter"
+    assert c["samples"][0][2] == 123
+    g = doc["cylon_tpu_elastic_epoch"]
+    assert g["type"] == "gauge" and g["samples"][0][2] == 2
+    hist = doc["cylon_tpu_serve_run_ms"]
+    assert hist["type"] == "histogram"
+    by_name = {}
+    for sname, labels, value in hist["samples"]:
+        assert labels.get("tenant") == "acme"
+        by_name.setdefault(sname, []).append((labels, value))
+    assert by_name["cylon_tpu_serve_run_ms_count"][0][1] == 2
+    assert by_name["cylon_tpu_serve_run_ms_sum"][0][1] == 903.0
+    inf = [v for lab, v in by_name["cylon_tpu_serve_run_ms_bucket"]
+           if lab["le"] == "+Inf"]
+    assert inf == [2]
+
+
+def test_openmetrics_parse_rejects_malformed():
+    with pytest.raises(ValueError, match="EOF"):
+        openmetrics.parse("# TYPE cylon_tpu_x counter\ncylon_tpu_x 1\n")
+    with pytest.raises(ValueError, match="precedes"):
+        openmetrics.parse("cylon_tpu_x 1\n# EOF\n")
+    bad = ("# TYPE cylon_tpu_h histogram\n"
+           'cylon_tpu_h_bucket{le="1"} 5\n'
+           'cylon_tpu_h_bucket{le="+Inf"} 3\n'
+           "cylon_tpu_h_sum 1\ncylon_tpu_h_count 3\n# EOF\n")
+    with pytest.raises(ValueError, match="monotone"):
+        openmetrics.parse(bad)
+
+
+def test_openmetrics_hostile_tenant_roundtrip():
+    """Tenant ids are arbitrary strings: '}'/'"'/newline in a label
+    value must survive render -> parse (the label block is quoted-pair
+    structured, not 'up to the first brace')."""
+    h = obs_metrics._Hist()
+    h.observe(3.0)
+    for tenant in ('a}b', 'a"b', "a\nb", "a\\b"):
+        snap = {"counters": {f"serve.shed[{tenant}]": 2}, "gauges": {},
+                "histograms": {f"serve.run_ms[{tenant}]": h.as_dict()}}
+        doc = openmetrics.parse(openmetrics.render(snap))
+        _, labels, v = doc["cylon_tpu_serve_shed_total"]["samples"][0]
+        assert labels["tenant"] == tenant and v == 2
+        hs = doc["cylon_tpu_serve_run_ms"]["samples"]
+        assert all(lab["tenant"] == tenant for _, lab, _ in hs)
+
+
+def test_plan_guard_epoch_resume_does_not_dump(ctx4, tmp_path):
+    """A pass_guard raising EpochMismatch (ordinary elastic resume) or
+    Cancelled (deliberate caller action) must NOT leave a plan_fatal
+    post-mortem — only classified terminal failures dump."""
+    from cylon_tpu.plan import executor as ex
+
+    rng = np.random.default_rng(41)
+    _, t, _, t2 = _tables(ctx4, rng)
+    for code in (ex.Code.EpochMismatch, ex.Code.Cancelled):
+        def guard():
+            raise CylonError(code, "membership moved / cancelled")
+
+        with config.knob_env(CYLON_TPU_TRACE_DIR=str(tmp_path)):
+            with pytest.raises(CylonError):
+                ex.execute(_q(t, t2), pass_guard=guard)
+    flight = os.path.join(str(tmp_path), "flight")
+    dumps = os.listdir(flight) if os.path.isdir(flight) else []
+    assert not dumps, f"resume/cancel signals must not dump: {dumps}"
+
+
+def test_openmetrics_server_scrape():
+    before = obs_metrics.counter_value("test.scrape_probe")
+    obs_metrics.counter_add("test.scrape_probe", 11)
+    srv = openmetrics.start_server(0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+        doc = openmetrics.parse(body)
+        samples = doc["cylon_tpu_test_scrape_probe_total"]["samples"]
+        assert samples[0][2] == before + 11
+        # scrape matches the live snapshot, not a stale cache
+        obs_metrics.counter_add("test.scrape_probe", 1)
+        body2 = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+        doc2 = openmetrics.parse(body2)
+        assert doc2["cylon_tpu_test_scrape_probe_total"]["samples"][0][2] \
+            == before + 12
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5)
+    finally:
+        srv.close()
+
+
+def test_openmetrics_knob_disabled_and_ensure(tmp_path):
+    with config.knob_env(CYLON_TPU_METRICS_PORT=None):
+        assert openmetrics.ensure_server() is None
+    openmetrics.stop_server()
+
+
+def test_render_fleet_rank_labels():
+    snaps = {"0": {"counters": {"x.y": 1}},
+             "1": {"counters": {"x.y": 2}},
+             "coord": {"counters": {"x.y": 3}}}
+    doc = openmetrics.parse(openmetrics.render_fleet(snaps))
+    samples = doc["cylon_tpu_x_y_total"]["samples"]
+    got = {lab["rank"]: v for _, lab, v in samples}
+    assert got == {"0": 1, "1": 2, "coord": 3}
+
+
+# ---------------------------------------------------------------------------
+# the profiler: per-node actuals
+# ---------------------------------------------------------------------------
+
+
+def test_profile_actuals_join_groupby(ctx4, tmp_path):
+    rng = np.random.default_rng(7)
+    d, t, d2, t2 = _tables(ctx4, rng)
+    plan = _q(t, t2)
+    with config.knob_env(CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        res, prof = plan.profile()
+    byk = {p.nid: p for p in _walk(prof.phys.root)}
+    recs = prof.nodes
+    # every scan records its input rows and zero-ish self time
+    scans = [nid for nid, p in byk.items()
+             if p.node.kind == "scan" and nid in recs]
+    assert len(scans) == 2
+    for nid in scans:
+        assert recs[nid]["rows"] == 400
+        assert "shard_rows" in recs[nid]
+        assert sum(recs[nid]["shard_rows"]) == 400
+    # the filter's actual selectivity is observable
+    filt = [nid for nid, p in byk.items()
+            if p.node.kind == "filter" and nid in recs]
+    assert len(filt) == 1
+    n_kept = int((d["v"] > np.float32(0.2)).sum())
+    assert recs[filt[0]]["rows"] == n_kept
+    # the fused join records rows from the exact count pass
+    joins = [nid for nid, p in byk.items()
+             if p.node.kind == "join" and nid in recs]
+    assert len(joins) == 1
+    assert recs[joins[0]].get("fused") is True
+    assert recs[joins[0]]["rows"] > 0
+    # the root aggregate carries the exchange bytes (self metrics)
+    root = prof.phys.root
+    sm = recs[root.nid]["self_metrics"]
+    assert sm.get("shuffle.bytes_sent", 0) > 0
+    assert recs[root.nid].get("skew") is not None
+    # artifact exported and loadable
+    assert prof.artifact_path and os.path.exists(prof.artifact_path)
+    from cylon_tpu.plan.profile import load_profile
+
+    doc = load_profile(prof.artifact_path)
+    assert doc["world"] == 4
+    assert any(n["rows"] == 400 for n in doc["nodes"])
+
+
+def _walk(p):
+    yield p
+    for c in p.children:
+        yield from _walk(c)
+
+
+def test_profiled_run_bit_identical_to_unprofiled(ctx4, tmp_path):
+    rng = np.random.default_rng(3)
+    _, t, _, t2 = _tables(ctx4, rng)
+    plain = _q(t, t2).execute().to_pandas().sort_values("k")
+    with config.knob_env(CYLON_TPU_PROFILE="1",
+                         CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        profiled = _q(t, t2).execute().to_pandas().sort_values("k")
+    for c in plain.columns:
+        np.testing.assert_array_equal(plain[c].to_numpy(),
+                                      profiled[c].to_numpy())
+
+
+def test_profiler_off_writes_no_artifact(local_ctx, tmp_path):
+    rng = np.random.default_rng(3)
+    d = {"k": rng.integers(0, 8, 64).astype(np.int32),
+         "v": rng.random(64).astype(np.float32)}
+    t = Table.from_numpy(list(d), list(d.values()), ctx=local_ctx)
+    with config.knob_env(CYLON_TPU_TRACE_DIR=str(tmp_path),
+                         CYLON_TPU_PROFILE=None):
+        t.plan().filter(col("v") > lit(0.5)).execute()
+    assert not [f for f in os.listdir(tmp_path)
+                if f.startswith("plan_profile")]
+
+
+def test_explain_analyze_text(ctx4, tmp_path):
+    rng = np.random.default_rng(5)
+    _, t, _, t2 = _tables(ctx4, rng)
+    with config.knob_env(CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        out = _q(t, t2).explain(analyze=True)
+    assert "analyze: wall=" in out
+    assert "<- [rows=" in out
+    assert "skew=" in out
+    # the non-analyze render is unchanged (no actuals, nothing ran)
+    plain = _q(t, t2).explain()
+    assert "<- [" not in plain
+
+
+def test_profile_shared_scan_self_join(ctx4, tmp_path):
+    """A self-join CSE'd by the shared-scan rule executes its chain via
+    _exec_chain — which must still profile: scan cardinality recorded,
+    and the join's selectivity reaches the catalog with the single
+    shared record standing in for BOTH input sides."""
+    rng = np.random.default_rng(37)
+    n = 320
+    d = {"k": rng.integers(0, 16, n).astype(np.int32),
+         "v": rng.random(n).astype(np.float32)}
+    t = Table.from_numpy(list(d), list(d.values()), ctx=ctx4)
+    root = str(tmp_path / "stats")
+    with config.knob_env(CYLON_TPU_STATS_DIR=root,
+                         CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        plan = t.plan().join(t.plan(), on="k")
+        phys = plan_optimizer.optimize(plan, enabled=True)
+        assert phys.root.ann.get("shared"), "shape must trigger CSE"
+        _, prof = plan.profile()
+        scan_recs = [prof.nodes[p.nid] for p in _walk(prof.phys.root)
+                     if p.node.kind == "scan" and p.nid in prof.nodes]
+        assert scan_recs and scan_recs[0]["rows"] == n
+        st = plan_optimizer.lookup_stats(plan)
+        j = list(st["joins"].values())
+        assert j and j[0]["left_rows"] == j[0]["right_rows"] == n
+        assert j[0]["selectivity"] is not None
+
+
+def test_profile_attaches_fleet_skew_ledger():
+    """The PR-8 coordinator skew ledger rides the profile when the
+    context runs under an elastic agent (stubbed: the attach path is
+    agent.status() -> collectives; the real verb is covered by
+    test_obs_fleet)."""
+
+    class _Agent:
+        def status(self):
+            return {"ok": True, "collectives": [
+                {"collective": "elastic.pass", "epoch": 0,
+                 "skew_ns": 2_000_000, "slowest_rank": 1}]}
+
+    class _Ctx:
+        def elastic_agent(self):
+            return _Agent()
+
+    prof = PlanProfile()
+    prof.attach_fleet_skew(_Ctx())
+    assert prof.fleet_skew and prof.fleet_skew[0]["slowest_rank"] == 1
+    assert prof.as_dict()["fleet_skew"] == prof.fleet_skew
+    # no agent -> absent, never an error
+    class _Plain:
+        def elastic_agent(self):
+            return None
+
+    p2 = PlanProfile()
+    p2.attach_fleet_skew(_Plain())
+    assert p2.fleet_skew is None
+
+
+# ---------------------------------------------------------------------------
+# statistics catalog
+# ---------------------------------------------------------------------------
+
+
+def test_stats_catalog_roundtrip_torn_tail_and_cap(tmp_path):
+    root = str(tmp_path / "stats")
+    with config.knob_env(CYLON_TPU_STATS_DIR=root,
+                         CYLON_TPU_STATS_CAP="3"):
+        stats_catalog.record("fp1", {"world": 2, "nodes": {}})
+        stats_catalog.record("fp2", {"world": 4, "nodes": {}})
+        assert stats_catalog.lookup("fp1") == {"world": 2, "nodes": {}}
+        # torn tail: a half-written append must not poison the file
+        path = os.path.join(root, stats_catalog.STATS_FILE)
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"v": 1, "key": "fp3", "stats": {"wor')
+        assert stats_catalog.lookup("fp2") == {"world": 4, "nodes": {}}
+        cat = stats_catalog.StatsCatalog.open(root)
+        assert cat.torn and set(cat.entries) == {"fp1", "fp2"}
+        # LRU cap: most recently written survive compaction
+        stats_catalog.record("fp3", {"world": 1})
+        stats_catalog.record("fp4", {"world": 1})
+        stats_catalog.record("fp5", {"world": 1})
+        assert set(stats_catalog.keys()) == {"fp3", "fp4", "fp5"}
+        # the compacted file is clean (no torn tail carried over)
+        cat2 = stats_catalog.StatsCatalog.open(root)
+        assert not cat2.torn
+        # rewrite of an existing key refreshes its LRU position
+        stats_catalog.record("fp3", {"world": 8})
+        stats_catalog.record("fp6", {"world": 1})
+        assert "fp3" in stats_catalog.keys()
+        assert stats_catalog.lookup("fp3") == {"world": 8}
+
+
+def test_stats_catalog_disabled_is_noop(tmp_path):
+    with config.knob_env(CYLON_TPU_STATS_DIR=None):
+        assert not stats_catalog.enabled()
+        assert stats_catalog.lookup("fp") is None
+        stats_catalog.record("fp", {})  # must not raise or write
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           stats_catalog.STATS_FILE))
+
+
+def test_profile_persists_stats_and_lookup(ctx4, tmp_path):
+    rng = np.random.default_rng(11)
+    d, t, d2, t2 = _tables(ctx4, rng)
+    root = str(tmp_path / "stats")
+    with config.knob_env(CYLON_TPU_STATS_DIR=root,
+                         CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        plan = _q(t, t2)
+        _, prof = plan.profile()
+        assert prof.fingerprint is not None
+        st = plan_optimizer.lookup_stats(plan)
+        assert st is not None and st["world"] == 4
+        # observed per-scan column cardinality
+        scans = list(st["scans"].values())
+        assert any(c["columns"].get("k", {}).get("nunique") == 24
+                   for c in scans)
+        # observed selectivities: the filter's, and the fused join's
+        f = list(st["filters"].values())
+        assert f and 0 < f[0]["selectivity"] <= 1
+        assert f[0]["out_rows"] == int((d["v"] > np.float32(0.2)).sum())
+        j = list(st["joins"].values())
+        assert j and j[0]["selectivity"] is not None
+        assert j[0]["left_rows"] and j[0]["right_rows"]
+        # second run renders estimates from the catalog
+        out = plan.explain(analyze=True)
+        assert "rows est=" in out and "estimates=catalog" in out
+
+
+def test_stats_catalog_reloads_in_second_process(ctx4, tmp_path):
+    rng = np.random.default_rng(13)
+    _, t, _, t2 = _tables(ctx4, rng)
+    root = str(tmp_path / "stats")
+    with config.knob_env(CYLON_TPU_STATS_DIR=root,
+                         CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        plan = _q(t, t2)
+        _, prof = plan.profile()
+        fp = prof.fingerprint
+    # a FRESH process (no shared state) reloads the persisted catalog
+    # and sees the observed selectivities under the same fingerprint
+    code = (
+        "import json, sys\n"
+        "from cylon_tpu.obs import stats_catalog\n"
+        "cat = stats_catalog.StatsCatalog.open(sys.argv[1])\n"
+        "st = cat.lookup(sys.argv[2])\n"
+        "assert st is not None, 'fingerprint missing'\n"
+        "assert st['filters'] and st['joins'], st\n"
+        "sel = list(st['filters'].values())[0]['selectivity']\n"
+        "assert 0 < sel <= 1, sel\n"
+        "print(json.dumps({'ok': True, 'selectivity': sel}))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code, root, fp],
+                         capture_output=True, text=True, env=env,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip())["ok"] is True
+
+
+def test_lookup_stats_advisory_bit_identity(ctx4, tmp_path):
+    """Plans are bit-identical with the catalog present or absent — the
+    advisory-only contract this PR pins for the future cost model."""
+    rng = np.random.default_rng(17)
+    _, t, _, t2 = _tables(ctx4, rng)
+    root = str(tmp_path / "stats")
+    base = _q(t, t2).execute().to_pandas().sort_values("k")
+    with config.knob_env(CYLON_TPU_STATS_DIR=root,
+                         CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        _q(t, t2).profile()  # seed the catalog
+        phys_with = plan_optimizer.optimize(_q(t, t2), enabled=True)
+        got = _q(t, t2).execute().to_pandas().sort_values("k")
+    phys_without = plan_optimizer.optimize(_q(t, t2), enabled=True)
+    assert phys_with.shuffles_elided == phys_without.shuffles_elided
+    assert phys_with.columns_pruned == phys_without.columns_pruned
+    for c in base.columns:
+        np.testing.assert_array_equal(base[c].to_numpy(),
+                                      got[c].to_numpy())
+
+
+def test_profile_cache_hit_path(ctx4, tmp_path):
+    rng = np.random.default_rng(19)
+    _, t, _, t2 = _tables(ctx4, rng)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path / "j"),
+                         CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        plan = _q(t, t2)
+        _, p1 = plan.profile()
+        assert p1.plan_cache_hit is False
+        _, p2 = plan.profile()
+        assert p2.plan_cache_hit is True
+        assert "served from journal" in plan.explain(analyze=True)
+
+
+# ---------------------------------------------------------------------------
+# planner-path flight dump (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_fatal_produces_flight_dump(ctx4, tmp_path):
+    from cylon_tpu import resilience
+
+    rng = np.random.default_rng(23)
+    _, t, _, t2 = _tables(ctx4, rng)
+    with config.knob_env(CYLON_TPU_TRACE_DIR=str(tmp_path),
+                         CYLON_TPU_RETRY_MAX="0"):
+        with resilience.fault_plan("shuffle+=unknown"):
+            # the unretryable injected fault propagates raw (resilience
+            # re-raises the original); the dump must fire regardless
+            with pytest.raises((CylonError, resilience.InjectedFault)):
+                _q(t, t2).execute()
+    flight = os.path.join(str(tmp_path), "flight")
+    dumps = [os.path.join(flight, f) for f in os.listdir(flight)] \
+        if os.path.isdir(flight) else []
+    assert dumps, "plan fatal must dump the flight recorder"
+    reasons = set()
+    for p in dumps:
+        doc = obs_fleet.load_flight(p)
+        reasons.add(doc["reason"])
+        reasons.update(e["reason"] for e in doc["terminal_events"])
+    assert "plan_fatal" in reasons, reasons
+
+
+# ---------------------------------------------------------------------------
+# coordinator metrics verb + fleet_status satellites
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_metrics_verb_and_fleet_status(capsys):
+    import time as time_mod
+
+    from cylon_tpu import elastic
+
+    sys.path.insert(0, TOOLS)
+    try:
+        import fleet_status
+    finally:
+        sys.path.remove(TOOLS)
+
+    obs_metrics.counter_add("test.fleet_probe", 5)
+    c = elastic.Coordinator(2, heartbeat_timeout_s=5.0).start()
+    a0 = elastic.Agent(c.address, 0, interval_s=0.05,
+                       timeout_s=5.0).start()
+    a1 = elastic.Agent(c.address, 1, interval_s=0.05,
+                       timeout_s=5.0).start()
+    try:
+        addr = f"{c.address[0]}:{c.address[1]}"
+        deadline = time_mod.monotonic() + 10.0
+        st = {}
+        while time_mod.monotonic() < deadline:
+            # raw=True returns the per-rank snapshots (--json's shape);
+            # the default reply carries ONLY the exposition text
+            st = fleet_status.request(addr, {"cmd": "metrics",
+                                             "raw": True})
+            if {"0", "1"} <= set(st.get("ranks") or {}):
+                break
+            time_mod.sleep(0.05)
+        assert {"0", "1", "coord"} <= set(st["ranks"]), list(st["ranks"])
+        assert "openmetrics" not in st  # one representation per reply
+        text_reply = fleet_status.request(addr, {"cmd": "metrics"})
+        assert "ranks" not in text_reply
+        doc = openmetrics.parse(text_reply["openmetrics"])
+        samples = doc["cylon_tpu_test_fleet_probe_total"]["samples"]
+        ranks = {lab["rank"] for _, lab, v in samples}
+        assert {"0", "1"} <= ranks
+        # CLI: --openmetrics prints the exposition text
+        rc = fleet_status.main([addr, "--openmetrics"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        openmetrics.parse(out)
+        # --max-reply-bytes degrade: a tiny cap warns instead of the
+        # historical hard ConnectionError; a one-chunk reply still
+        # parses (truncation only bites replies spanning reads)
+        rc = fleet_status.main([addr, "--openmetrics",
+                                "--max-reply-bytes", "64"])
+        err = capsys.readouterr().err
+        assert "WARNING" in err and "max-reply-bytes" in err
+        # parseable one-chunk reply (rc 0, warned) vs a genuinely
+        # truncated multi-read reply (rc 3 — distinct from rc 1
+        # "unreachable": the coordinator DID answer)
+        assert rc in (0, 3)
+    finally:
+        a0.leave()
+        a1.leave()
+        c.stop()
+
+
+def test_metrics_pruned_with_dead_rank():
+    import time as time_mod
+
+    from cylon_tpu import elastic
+    from cylon_tpu.net import control
+
+    c = elastic.Coordinator(2, heartbeat_timeout_s=5.0).start()
+    a0 = elastic.Agent(c.address, 0, interval_s=0.05,
+                       timeout_s=5.0).start()
+    a1 = elastic.Agent(c.address, 1, interval_s=0.05,
+                       timeout_s=5.0).start()
+    try:
+        deadline = time_mod.monotonic() + 10.0
+        while time_mod.monotonic() < deadline:
+            resp = control.request(c.address,
+                                   {"cmd": "metrics", "raw": True})
+            if {"0", "1"} <= set(resp.get("ranks") or {}):
+                break
+            time_mod.sleep(0.05)
+        a1.leave()  # clean death: rank 1's metrics must leave the view
+        deadline = time_mod.monotonic() + 10.0
+        while time_mod.monotonic() < deadline:
+            resp = control.request(c.address,
+                                   {"cmd": "metrics", "raw": True})
+            if "1" not in (resp.get("ranks") or {}):
+                break
+            time_mod.sleep(0.05)
+        assert "1" not in resp["ranks"], list(resp["ranks"])
+        assert "0" in resp["ranks"]
+    finally:
+        a0.leave()
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# trace_report satellites
+# ---------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    import importlib.util
+
+    p = os.path.join(TOOLS, f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_{name}", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_plan_flag(ctx4, tmp_path, capsys):
+    rng = np.random.default_rng(29)
+    _, t, _, t2 = _tables(ctx4, rng)
+    with config.knob_env(CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        _, prof = _q(t, t2).profile()
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
+    tr = _load_tool("trace_report")
+    rc = tr.main([str(trace), "--plan", prof.artifact_path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "plan profile" in out
+    assert "scan" in out and "groupby" in out
+    rep = tr.report_dict(str(trace), None, 10, prof.artifact_path)
+    assert rep["plan"]["kind"] == "cylon_tpu.plan_profile"
+    assert any(n["rows"] == 400 for n in rep["plan"]["nodes"])
+    with pytest.raises(ValueError, match="not a plan profile"):
+        tr.load_plan_profile(str(trace))
+
+
+def test_trace_report_compression_counters(tmp_path, capsys):
+    tr = _load_tool("trace_report")
+    trace = tmp_path / "trace.r0.json"
+    trace.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
+    metrics_p = tmp_path / "metrics.r0.json"
+    metrics_p.write_text(json.dumps({
+        "counters": {"shuffle.bytes_sent": 1000,
+                     "shuffle.bytes_saved": 4000},
+        "gauges": {"shuffle.compress_ratio": 5.0},
+        "histograms": {}}))
+    rc = tr.main([str(trace), str(metrics_p)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "bytes saved (compression)" in out
+    assert "4000" in out and "5.00x" in out
+    rep = tr.report_dict(str(trace), str(metrics_p), 10)
+    assert rep["counters"]["shuffle.bytes_saved"] == 4000
+    assert rep["gauges"]["shuffle.compress_ratio"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# serve-path profiling stays compatible
+# ---------------------------------------------------------------------------
+
+
+def test_run_service_with_profiler_knob(ctx4, tmp_path):
+    rng = np.random.default_rng(31)
+    _, t, _, t2 = _tables(ctx4, rng)
+    with config.knob_env(CYLON_TPU_PROFILE="1",
+                         CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        frame, stats = plan_executor.run_service(_q(t, t2))
+    assert stats["rows"] == len(next(iter(frame.values())))
+    assert [f for f in os.listdir(tmp_path)
+            if f.startswith("plan_profile")]

@@ -9,11 +9,18 @@ coordinator's own clock), and the aggregated serving view — total queue
 depth plus per-tenant SLO latency histograms (queue-wait vs run split)
 merged across every rank's heartbeat telemetry.
 
+``--openmetrics`` asks the coordinator's ``metrics`` verb instead: the
+fleet-wide Prometheus exposition text (every rank's heartbeat-shipped
+metrics snapshot plus the coordinator's own, rank-labeled) straight to
+stdout — pipe it to a file a node_exporter-style textfile collector
+picks up, or eyeball it.
+
 Pure stdlib (no jax, no package import) so it runs anywhere a socket
 reaches the coordinator.
 
 Usage:
-    python tools/fleet_status.py HOST:PORT [--json] [--timeout S]
+    python tools/fleet_status.py HOST:PORT [--json] [--openmetrics]
+                                 [--timeout S] [--max-reply-bytes N]
 """
 from __future__ import annotations
 
@@ -23,27 +30,58 @@ import socket
 import sys
 from typing import Dict
 
+DEFAULT_MAX_REPLY = 64 << 20
 
-def request(address: str, obj: Dict, timeout: float = 5.0) -> Dict:
+
+class ReplyTruncated(ValueError):
+    """The reply exceeded --max-reply-bytes AND the truncated buffer was
+    unparseable — distinct from an unreachable coordinator: the peer
+    answered fine, the CAP is what bit (exit code 3, not 1)."""
+
+
+def request(address: str, obj: Dict, timeout: float = 5.0,
+            max_reply_bytes: int = DEFAULT_MAX_REPLY) -> Dict:
     """One JSON request/response round trip (the net/control.py wire
-    format, re-implemented so the tool stays dependency-free)."""
+    format, re-implemented so the tool stays dependency-free).
+
+    Replies past ``max_reply_bytes`` are TRUNCATED with a stderr
+    warning instead of the historical hard ``ConnectionError`` at
+    1 MiB — a big fleet's status must stay inspectable, and the caller
+    decides what a truncated (unparseable) reply is worth.  Raises
+    ``ValueError`` with a clear raise-the-cap hint when the truncated
+    buffer cannot parse."""
     host, _, port = address.rpartition(":")
     if not host or not port:
         raise ValueError(f"bad coordinator address {address!r} "
                          f"(want host:port)")
+    truncated = False
     with socket.create_connection((host, int(port)),
                                   timeout=timeout) as sock:
         sock.settimeout(timeout)
         sock.sendall(json.dumps(obj, sort_keys=True).encode() + b"\n")
         buf = bytearray()
         while not buf.endswith(b"\n"):
-            chunk = sock.recv(4096)
+            chunk = sock.recv(65536)
             if not chunk:
-                raise ConnectionError("coordinator closed mid-reply")
+                break  # peer closed; parse whatever arrived
             buf.extend(chunk)
-            if len(buf) > (1 << 20):
-                raise ConnectionError("status reply exceeds 1 MiB")
-    return json.loads(buf.decode())
+            if len(buf) > max_reply_bytes:
+                truncated = True
+                print(f"fleet_status: WARNING: reply exceeds "
+                      f"--max-reply-bytes={max_reply_bytes}; truncating "
+                      f"(raise the cap to see the whole fleet)",
+                      file=sys.stderr)
+                break
+    try:
+        return json.loads(buf.decode(errors="replace"))
+    except ValueError as e:
+        if truncated:
+            raise ReplyTruncated(
+                f"status reply truncated at {len(buf)} bytes and "
+                f"unparseable; re-run with a larger --max-reply-bytes"
+            ) from e
+        raise ConnectionError(
+            f"coordinator closed mid-reply ({len(buf)} bytes)") from e
 
 
 def _hist_line(h: Dict) -> str:
@@ -112,13 +150,47 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=5.0)
     ap.add_argument("--json", action="store_true",
                     help="raw status JSON on stdout")
+    ap.add_argument("--openmetrics", action="store_true",
+                    help="fleet-wide Prometheus text exposition from the "
+                         "coordinator's metrics verb (rank-labeled "
+                         "samples) instead of the status view")
+    ap.add_argument("--max-reply-bytes", type=int,
+                    default=DEFAULT_MAX_REPLY,
+                    help="cap on one coordinator reply; past it the "
+                         "reply is truncated with a warning instead of "
+                         "a hard failure (default 64 MiB)")
     args = ap.parse_args(argv)
+    if args.openmetrics:
+        # one representation per reply: exposition text by default, raw
+        # per-rank snapshots under --json (the coordinator ships only
+        # what was asked — both at once doubled every scrape)
+        obj = {"cmd": "metrics", "raw": True} if args.json \
+            else {"cmd": "metrics"}
+    else:
+        obj = {"cmd": "status"}
     try:
-        st = request(args.address, {"cmd": "status"}, timeout=args.timeout)
+        st = request(args.address, obj, timeout=args.timeout,
+                     max_reply_bytes=args.max_reply_bytes)
+    except ReplyTruncated as e:
+        # the coordinator answered; the CAP bit — say so, distinctly
+        print(f"fleet_status: {e}", file=sys.stderr)
+        return 3
     except (OSError, ValueError) as e:
         print(f"fleet_status: coordinator unreachable at {args.address}: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
         return 1
+    if args.openmetrics:
+        if args.json:
+            json.dump(st, sys.stdout, indent=1, sort_keys=True)
+            print()
+            return 0
+        text = st.get("openmetrics")
+        if not isinstance(text, str):
+            print(f"fleet_status: coordinator returned no exposition "
+                  f"text: {str(st)[:200]}", file=sys.stderr)
+            return 1
+        sys.stdout.write(text)
+        return 0
     if args.json:
         json.dump(st, sys.stdout, indent=1, sort_keys=True)
         print()

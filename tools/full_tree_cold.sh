@@ -522,6 +522,102 @@ if [ $rc -ne 0 ]; then
   echo "compression smoke failed (rc=$rc); fix the payload encoder before the full tree" >&2
   exit $rc
 fi
+# profiler smoke (ISSUE-12): TPC-H Q10 with the query profiler on — the
+# OpenMetrics endpoint is scraped MID-RUN (a thread concurrent with
+# plan.execute), the exposition text is validated by the stdlib parser,
+# the per-node analyze output must carry nonzero rows/exchange bytes,
+# and the statistics catalog must hold the run's observed selectivities
+# — asserted from artifact JSON; catches a profiler/exporter regression
+# in ~2 min, before the full tree runs
+PF=$(mktemp -d /tmp/cylon_profile_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    CYLON_TPU_PROFILE=1 CYLON_TPU_STATS_DIR="$PF/stats" \
+    CYLON_TPU_TRACE_DIR="$PF/traces" \
+    python - "$PF" <<'PYEOF'
+import json, sys, threading, urllib.request
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cylon_tpu.obs import openmetrics
+from examples import tpch_q10, tpch_data
+from examples.util import default_ctx, table_from_arrays
+import numpy as np
+
+out_dir = sys.argv[1]
+srv = openmetrics.start_server(0)  # ephemeral scrape port
+scrapes = []
+
+def scraper(stop):
+    while not stop.wait(0.2):
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+            ).read().decode()
+            scrapes.append(body)
+        except OSError:
+            pass
+
+ctx = default_ctx(None)
+rng = np.random.default_rng(0)
+raw_c = tpch_data.customer(0.004, rng)
+raw_o = tpch_data.orders(0.004, rng)
+raw_l = tpch_data.lineitem(0.004, rng, q5_keys=True,
+                           orders_rows=len(raw_o["o_orderkey"]))
+raw_l.pop("l_suppkey", None)
+plan = tpch_q10.build_plan(
+    table_from_arrays(raw_c, ctx), table_from_arrays(raw_o, ctx),
+    table_from_arrays(raw_l, ctx),
+    table_from_arrays(tpch_data.nation(), ctx))
+
+stop = threading.Event()
+th = threading.Thread(target=scraper, args=(stop,), daemon=True)
+th.start()
+analyzed = plan.explain(analyze=True)   # one profiled execution
+_, prof = plan.profile()                # profile artifact + catalog
+stop.set(); th.join(timeout=5)
+final = urllib.request.urlopen(
+    f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+scrapes.append(final)
+srv.close()
+
+from cylon_tpu.plan import optimizer
+stats = optimizer.lookup_stats(plan)
+rec = {"analyzed": analyzed, "scrapes": len(scrapes),
+       "profile": prof.as_dict(),
+       "stats_joins": (stats or {}).get("joins", {}),
+       "stats_filters": (stats or {}).get("filters", {}),
+       "last_scrape": scrapes[-1]}
+with open(f"{out_dir}/profile_smoke.json", "w") as fh:
+    json.dump(rec, fh)
+# validate EVERY scrape (mid-run included) with the stdlib parser
+for body in scrapes:
+    openmetrics.parse(body)
+PYEOF
+rc=$?
+if [ $rc -eq 0 ]; then
+  python - "$PF" <<'PYEOF'
+import json, sys
+rec = json.load(open(f"{sys.argv[1]}/profile_smoke.json"))
+assert rec["scrapes"] >= 1, rec["scrapes"]
+assert "<- [rows" in rec["analyzed"], rec["analyzed"]
+nodes = rec["profile"]["nodes"]
+assert any(n["rows"] > 0 for n in nodes), nodes
+sent = sum(n["metrics"].get("shuffle.bytes_sent", 0) for n in nodes)
+assert sent > 0, "no per-node exchange bytes recorded"
+assert rec["stats_joins"], "catalog missing join selectivities"
+assert rec["stats_filters"], "catalog missing filter selectivities"
+assert "cylon_tpu_shuffle_bytes_sent_total" in rec["last_scrape"]
+print(f"profiler smoke ok: {len(nodes)} profiled nodes, "
+      f"{sent} exchange bytes attributed, {rec['scrapes']} clean "
+      f"scrapes, catalog selectivities persisted")
+PYEOF
+  rc=$?
+fi
+rm -rf "$PF"
+if [ $rc -ne 0 ]; then
+  echo "profiler smoke failed (rc=$rc); fix the query profiler before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

@@ -161,8 +161,45 @@ def _dropped_warning(where: str, dropped: int) -> None:
               file=sys.stderr)
 
 
+def load_plan_profile(path: str) -> dict:
+    """Load and validate a plan-profile artifact (the JSON
+    ``plan/profile.py`` exports; schema duplicated here so the reporter
+    stays a pure-JSON tool)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("kind") != "cylon_tpu.plan_profile":
+        raise ValueError(f"{path}: not a plan profile "
+                         f"(kind={doc.get('kind')!r})")
+    if not isinstance(doc.get("nodes"), list):
+        raise ValueError(f"{path}: nodes is not a list")
+    return doc
+
+
+def print_plan_profile(doc: dict) -> None:
+    """Per-plan-node EXPLAIN ANALYZE table from a profile artifact:
+    the tree (indented by depth), estimate→actual rows, self time,
+    exchange bytes, and shard skew with the slowest shard named."""
+    print(f"\nplan profile: world={doc.get('world')} "
+          f"wall={doc.get('wall_ms', 0):.1f}ms "
+          f"cache_hit={doc.get('plan_cache_hit')} "
+          f"estimates={'catalog' if doc.get('had_estimates') else '-'}")
+    print(f"  {'node':44s} {'est rows':>9s} {'rows':>9s} {'self ms':>9s} "
+          f"{'bytes sent':>11s} {'skew':>8s}")
+    for n in sorted(doc.get("nodes") or [], key=lambda n: n.get("nid", 0)):
+        label = ("  " * int(n.get("depth", 0))
+                 + str(n.get("desc") or n.get("kind") or "?"))[:44]
+        est = n.get("est_rows")
+        bytes_sent = int((n.get("metrics") or {}).get(
+            "shuffle.bytes_sent", 0))
+        skew = (f"{n['skew']:.2f}@r{n.get('slowest_shard')}"
+                if n.get("skew") is not None else "-")
+        print(f"  {label:44s} {'-' if est is None else est:>9} "
+              f"{n.get('rows', 0):>9} {n.get('self_ms', 0):>9.2f} "
+              f"{bytes_sent:>11d} {skew:>8s}")
+
+
 def report_dict(trace_path: str, metrics_path: Optional[str],
-                top: int) -> dict:
+                top: int, plan_path: Optional[str] = None) -> dict:
     """The whole report as one machine-readable object (``--json``)."""
     doc = load_trace(trace_path)
     events = doc["traceEvents"]
@@ -175,6 +212,7 @@ def report_dict(trace_path: str, metrics_path: Optional[str],
     metrics_path = _sibling_metrics(trace_path, metrics_path)
     m = load_metrics(metrics_path) if metrics_path else {}
     return {
+        **({"plan": load_plan_profile(plan_path)} if plan_path else {}),
         "trace": trace_path,
         "rank": other.get("rank"),
         "run_id": other.get("run_id"),
@@ -197,6 +235,7 @@ def report_dict(trace_path: str, metrics_path: Optional[str],
         "slo": slo_rows(m),
         "metrics": metrics_path,
         "counters": m.get("counters", {}),
+        "gauges": m.get("gauges", {}),
     }
 
 
@@ -287,6 +326,7 @@ def print_report(trace_path: str, metrics_path: "str | None",
                         continue
                     print(f"  {t:20s} {kind[:-3]:>12s} {h['count']:6d} "
                           f"{h['mean_ms']:9.2f} {h['max_ms']:9.2f}")
+        g = m.get("gauges", {})
         print(f"\nmetrics: {metrics_path}")
         print(f"  shuffle exchanges          {c.get('shuffle.exchanges', 0):>12}")
         print(f"  collective launches        "
@@ -295,6 +335,14 @@ def print_report(trace_path: str, metrics_path: "str | None",
               f"{c.get('shuffle.counts_gathers', 0):>12}")
         print(f"  bytes sent                 "
               f"{c.get('shuffle.bytes_sent', 0):>12}")
+        if "shuffle.bytes_saved" in c or "shuffle.compress_ratio" in g:
+            # the PR-10 compression win belongs in the standard report:
+            # bytes that never traveled, and the last exchange's ratio
+            ratio = g.get("shuffle.compress_ratio")
+            print(f"  bytes saved (compression)  "
+                  f"{int(c.get('shuffle.bytes_saved', 0)):>12}"
+                  + (f"  (last ratio {float(ratio):.2f}x)"
+                     if ratio else ""))
         print(f"  plan cache hit/miss        "
               f"{c.get('plan_cache.hit', 0)}/{c.get('plan_cache.miss', 0)}")
         print(f"  retries / oom refinements  "
@@ -355,14 +403,21 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout (totals, "
                          "skew table, per-tenant SLO rows)")
+    ap.add_argument("--plan", default=None, metavar="PROFILE.json",
+                    help="also summarize a plan-profile artifact "
+                         "(plan_profile.rN.json from a profiled run / "
+                         "EXPLAIN ANALYZE): per-node estimate->actual "
+                         "rows, self time, exchange bytes, shard skew")
     args = ap.parse_args(argv)
     if args.json:
-        rep = report_dict(args.trace, args.metrics, args.top)
+        rep = report_dict(args.trace, args.metrics, args.top, args.plan)
         _dropped_warning(args.trace, rep["dropped_events"])
         json.dump(rep, sys.stdout, indent=1, sort_keys=True)
         print()
         return 0
     print_report(args.trace, args.metrics, args.top)
+    if args.plan:
+        print_plan_profile(load_plan_profile(args.plan))
     return 0
 
 
