@@ -261,6 +261,59 @@ def _save_join_count(rows: int, m: int) -> None:
         _log(f"join-count cache save failed: {e}")
 
 
+def _traced_run():
+    """A context-manager factory rooting one measured sweep in a fresh
+    causal trace (ISSUE-13): under ``CYLON_TPU_TRACE=1`` every span the
+    sweep records becomes a child of a ``bench.sweep`` root span, so the
+    exported artifact supports the critical-path decomposition stamped
+    into the fragment.  A no-op ``nullcontext`` factory when event
+    tracing is off — the measured path gains nothing."""
+    import contextlib
+
+    from cylon_tpu.obs import spans as _obs_spans
+    from cylon_tpu.obs import tracectx as _tracectx
+
+    if not _obs_spans.events_enabled():
+        return lambda **kw: contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def run(**attrs):
+        with _tracectx.activate(_tracectx.new_trace()), \
+                _obs_spans.span("bench.sweep", **attrs):
+            yield
+
+    return run
+
+
+def _bench_critical_path(trace_path: str) -> "dict | None":
+    """The critical-path summary for one exported sweep artifact —
+    total, top-3 path segments, wait fraction — via
+    ``tools/critical_path.py`` (loaded by file path: bench must not
+    import the package for a reporting extra).  None (never a raise) on
+    any failure: attribution is an annotation, not a gate."""
+    try:
+        import importlib.util
+
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "critical_path.py")
+        spec = importlib.util.spec_from_file_location("_bench_cp", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        with open(trace_path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+        cp = mod.critical_path(doc.get("traceEvents") or [])
+        if cp is None:
+            return None
+        return {"trace_id": cp["trace_id"],
+                "total_ms": round(cp["total_us"] / 1e3, 3),
+                "coverage": cp["coverage"],
+                "wait_fraction": cp["wait_fraction"],
+                "top_segments": cp["top_segments"]}
+    except Exception as e:
+        _log(f"critical-path summary failed: {type(e).__name__}: {e}")
+        return None
+
+
 def _measure_chunked(rows: int, passes: int, emit=None):
     """(steady rows/sec/chip, cold rows/sec/chip) of the out-of-core
     key-range-chunked pipeline (cylon_tpu/exec.py) — the path to row counts
@@ -275,6 +328,7 @@ def _measure_chunked(rows: int, passes: int, emit=None):
     lk, lv, rk, rv = _make_data(rows)
     best = None
     cold = None  # first sweep's plan+run rows/sec: the honest one-shot cost
+    trace_run = _traced_run()
 
     if emit is not None:
         # per-pass provisional fragments: a tunnel drop or deadline mid-
@@ -290,7 +344,9 @@ def _measure_chunked(rows: int, passes: int, emit=None):
         exec_mod.PASS_PROGRESS_HOOK = _progress
     try:
         for sweep in range(2):  # sweeps are expensive; plan/compile amortized
-            _, stats = chunked_join_groupby(lk, lv, rk, rv, passes, algo=algo)
+            with trace_run(rows=rows, sweep=sweep):
+                _, stats = chunked_join_groupby(lk, lv, rk, rv, passes,
+                                                algo=algo)
             _log(f"chunked rows={rows} passes={stats['passes']} "
                  f"plan={stats['plan_seconds']:.1f}s "
                  f"run={stats['run_seconds']:.1f}s "
@@ -403,6 +459,21 @@ def _worker(backend: str, skip: int = 0) -> int:
                 emit_fragment, "trace_seq", -1) + 1
             tp, _mp = _obs_export.export_all(prefix=f"bench.{rows}.{seq}")
             frag["trace_artifact"] = tp
+            # ISSUE-13: the measurement carries its own attribution —
+            # the sweep's critical path (total, top-3 segments, wait
+            # fraction) rides the fragment into the artifact ledger
+            cp = _bench_critical_path(tp)
+            if cp is None:
+                # a re-emit of an already-measured value (the worker's
+                # final fragment, exported after the sweep buffers reset)
+                # keeps the measurement's own attribution — keyed by rows
+                # so a different sweep's path is never borrowed
+                prev_rows, prev_cp = getattr(emit_fragment, "last_cp",
+                                             (None, None))
+                cp = prev_cp if prev_rows == rows else None
+            if cp is not None:
+                frag["critical_path"] = cp
+                emit_fragment.last_cp = (rows, cp)
             _obs_spans.reset()
             _obs_metrics.reset()
         print(json.dumps(frag), flush=True)
@@ -416,7 +487,8 @@ def _worker(backend: str, skip: int = 0) -> int:
                     emit=lambda v, c, partial=None: emit_fragment(
                         v, rows, c, partial))
             else:
-                value, cold = _measure(rows), None
+                with _traced_run()(rows=rows):
+                    value, cold = _measure(rows), None
         except Exception as e:  # OOM / compile failure: step down
             _log(f"rows={rows} failed: {type(e).__name__}: {str(e)[:300]}")
             continue
@@ -581,6 +653,11 @@ class _Bench:
             out["cache_served"] = True
         if r.get("trace_artifact"):
             out["trace_artifact"] = r["trace_artifact"]
+        if r.get("critical_path"):
+            # ISSUE-13: the measurement's own attribution — critical-path
+            # total, top-3 segments, wait fraction — rides the artifact,
+            # so a tunnel-window number explains ITSELF
+            out["critical_path"] = r["critical_path"]
         if r.get("passes"):
             out["passes"] = r["passes"]
             if r.get("value_cold") is not None:

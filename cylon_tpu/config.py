@@ -434,6 +434,32 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
        accessors=("cylon_tpu.obs.spans.buffer_cap",),
        help="Maximum buffered span events per process; past it new events "
             "are dropped and counted (obs.spans.dropped), never grown."),
+    _K("CYLON_TPU_TRACE_TAIL_MS", "float", 0.0, RUNTIME,
+       accessors=("cylon_tpu.obs.tracectx.tail_threshold_ms",),
+       help="Tail-based trace retention: a closing serve request KEEPS "
+            "its buffered span events only when it was slow (latency "
+            "above this many milliseconds, or above the rolling p99 "
+            "estimate), failed, or head-sampled "
+            "(CYLON_TPU_TRACE_SAMPLE_N); fast-and-healthy requests keep "
+            "only the aggregate stopwatch — their events are discarded "
+            "at request close and counted in trace.tail_dropped.  "
+            "0 (default) disables retention: every buffered event is "
+            "kept (the pre-PR-13 behavior)."),
+    _K("CYLON_TPU_TRACE_SAMPLE_N", "int", 0, RUNTIME,
+       accessors=("cylon_tpu.obs.tracectx.head_sample_n",),
+       help="1-in-N head sampling for causal request traces: every Nth "
+            "trace the serve front door mints is marked sampled and "
+            "survives tail-based retention regardless of latency.  "
+            "0 (default) disables head sampling."),
+    _K("CYLON_TPU_TRACEPARENT", "str", "", RUNTIME,
+       accessors=("cylon_tpu.obs.tracectx.current",),
+       help="Ambient W3C traceparent (00-<32 hex trace>-<16 hex span>-"
+            "<2 hex flags>) adopted as this process's root trace context "
+            "whenever no request-scoped context is active — the "
+            "deployment hook for rooting a whole worker process in a "
+            "caller's trace (the CI tracing smoke roots rank 0 with it; "
+            "peers join causally via barrier propagation).  Empty "
+            "(default) leaves spans unstamped outside active requests."),
     _K("CYLON_TPU_RUN_ID", "str", "", RUNTIME,
        accessors=("cylon_tpu.obs.fleet.current_run_id",),
        help="Logical run id namespacing trace/metrics exports "

@@ -62,6 +62,7 @@ import numpy as np
 from . import config
 from .obs import metrics as obs_metrics
 from .obs import spans as obs_spans
+from .obs import tracectx
 from .status import Code, CylonError
 
 log = logging.getLogger("cylon_tpu")
@@ -670,16 +671,25 @@ class PassDeadline:
         self.site = site
         self.fired = threading.Event()
         self._timer: Optional[threading.Timer] = None
+        self._trace: Optional[tracectx.TraceContext] = None
 
     def _fire(self) -> None:
         self.fired.set()
-        obs_spans.instant("deadline.fired", site=self.site,
-                          deadline_s=self.seconds)
+        with tracectx.activate(self._trace):
+            obs_spans.instant("deadline.fired", site=self.site,
+                              deadline_s=self.seconds)
         obs_metrics.counter_add("deadline.fired")
         log.warning("durable: pass deadline %.3fs exceeded at %s "
                     "(CYLON_TPU_PASS_DEADLINE_S)", self.seconds, self.site)
 
     def __enter__(self) -> "PassDeadline":
+        # the request trace active on the ARMING thread, captured at
+        # __enter__ (serve constructs the deadline BEFORE activating the
+        # ticket's context): the watchdog fires on its own timer thread
+        # (fresh contextvar state), so without this capture the terminal
+        # `deadline.fired` instant could never be joined to the request
+        # whose budget it killed
+        self._trace = tracectx.current()
         self._timer = threading.Timer(self.seconds, self._fire)
         self._timer.daemon = True
         self._timer.start()

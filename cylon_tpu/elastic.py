@@ -95,6 +95,7 @@ from .net import control
 from .obs import fleet as obs_fleet
 from .obs import metrics as obs_metrics
 from .obs import spans as obs_spans
+from .obs import tracectx
 from .status import Code, CylonError, Status
 
 log = logging.getLogger("cylon_tpu")
@@ -463,6 +464,12 @@ class Coordinator:
         # collective's SKEW — the slowest participant's cost to everyone
         # (the arxiv 1810.11112 attribution, measured on one real clock)
         self._barriers: Dict[Tuple[str, int], Dict[int, int]] = {}
+        # per-barrier causal trace: the first arrival presenting a
+        # traceparent names the trace the rendezvous belongs to; every
+        # poll reply echoes it, so ranks that arrived WITHOUT a context
+        # adopt the requester's trace (cross-rank propagation rides the
+        # rendezvous — the one point every member passes through)
+        self._barrier_traces: Dict[Tuple[str, int], str] = {}
         self._clocks: Dict[int, Dict] = {}       # rank -> offset/uncertainty
         self._telemetry: Dict[int, Dict] = {}    # rank -> serve telemetry
         self._metrics: Dict[int, Dict] = {}      # rank -> metrics snapshot
@@ -624,6 +631,26 @@ class Coordinator:
         self._pending_log.append({"kind": "dead", "rank": int(rank),
                                   "reason": reason, "epoch": self._epoch,
                                   "inc": self.incarnation})
+        # the trace the fleet was rendezvousing in when the rank died:
+        # joining the rank-loss instant/dump to the request trace it
+        # killed is exactly what a post-mortem needs (resolved BEFORE the
+        # stale-barrier sweep below discards the pending arrival sets).
+        # Prefer a pending barrier the dead rank never ARRIVED at — that
+        # is the rendezvous the survivors are stalled in because of it;
+        # with concurrent traced rendezvous (multi-tenant serve) this
+        # picks the request the death actually wounded, not whichever
+        # barrier registered its trace first
+        pending = sorted(self._barriers, key=lambda k: k[1], reverse=True)
+        stalled = [k for k in pending if rank not in self._barriers[k]]
+        lost_tp = next(
+            (self._barrier_traces[k] for k in stalled + pending
+             if k in self._barrier_traces), None)
+        if lost_tp is None and self._barrier_traces:
+            # a trace can be latched before the gang forms (no arrival
+            # set yet): fall back to the latest-epoch registered trace
+            lost_tp = self._barrier_traces[
+                max(self._barrier_traces, key=lambda k: k[1])]
+        lost_trace = tracectx.parse_or_none(lost_tp)
         # rank loss is a classified terminal event: the coordinator's
         # flight dump records WHO died, WHY, and the control-plane events
         # leading up to it — even when the dead process took its own
@@ -636,14 +663,21 @@ class Coordinator:
             self._pending_flight.append(("rank_lost", dict(
                 lost_rank=rank, loss_reason=reason, epoch=self._epoch,
                 incarnation=self.incarnation,
-                members=sorted(self._last_hb))))
+                members=sorted(self._last_hb),
+                **({"trace_id": lost_trace.trace_id}
+                   if lost_trace is not None else {}))))
         # pending barriers from earlier epochs can never complete (their
         # pollers get epoch_changed and re-enter at the new epoch): drop
         # them so arrival sets don't accumulate across a long shrink
         for key in [k for k in self._barriers if k[1] < self._epoch]:
             del self._barriers[key]
-        obs_spans.instant("elastic.rank_lost", rank=rank, reason=reason,
-                          epoch=self._epoch)
+        for key in [k for k in self._barrier_traces if k[1] < self._epoch]:
+            del self._barrier_traces[key]
+        obs_spans.instant(
+            "elastic.rank_lost", rank=rank, reason=reason,
+            epoch=self._epoch,
+            **({"trace_id": lost_trace.trace_id}
+               if lost_trace is not None else {}))
         obs_metrics.counter_add("elastic.rank_lost")
         obs_metrics.gauge_set("elastic.epoch", self._epoch)
         log.warning("elastic: rank %d declared dead (%s); epoch -> %d, "
@@ -659,21 +693,30 @@ class Coordinator:
                 "incarnation": self.incarnation}
 
     def _record_skew_locked(self, name: str, epoch: int,
-                            arrived: Dict[int, int]) -> None:
+                            arrived: Dict[int, int],
+                            traceparent: Optional[str] = None) -> None:
         """Account one completed rendezvous: the arrival spread IS the
         collective's skew (everyone waits for the last arrival), on the
-        coordinator's single clock — no alignment uncertainty at all."""
+        coordinator's single clock — no alignment uncertainty at all.
+        The barrier's causal trace (first arrival presenting one) rides
+        the row and the instant, joining the skew ledger to the request
+        that paid for the wait."""
         first = min(arrived.values())
         slowest = max(arrived, key=arrived.get)
         skew_ns = arrived[slowest] - first
+        tctx = tracectx.parse_or_none(traceparent)
         obs_metrics.hist_observe("collective.skew_ns", skew_ns)
         obs_spans.instant("collective.skew", collective=name, epoch=epoch,
-                          skew_ns=skew_ns, slowest_rank=slowest)
+                          skew_ns=skew_ns, slowest_rank=slowest,
+                          **({"trace_id": tctx.trace_id}
+                             if tctx is not None else {}))
         row = {
             "collective": name, "epoch": epoch, "skew_ns": int(skew_ns),
             "slowest_rank": int(slowest),
             "arrivals_ns": {str(r): int(t - first)
                             for r, t in sorted(arrived.items())}}
+        if tctx is not None:
+            row["trace_id"] = tctx.trace_id
         self._skews.append(row)
         self._pending_log.append({"kind": "skew", "row": row,
                                    "inc": self.incarnation})
@@ -808,6 +851,7 @@ class Coordinator:
                     self._last_hb = {r: now
                                      for r in sorted(self._last_hb)}
                 self._barriers.clear()   # pending arrivals died with the
+                self._barrier_traces.clear()
                 self._clocks.clear()     # old incarnation; latches are
                 self._telemetry.clear()  # durable
                 self._metrics.clear()
@@ -985,16 +1029,33 @@ class Coordinator:
                     # got "go" and LEFT (bumping the epoch) must not
                     # convert the others' still-pending polls into a
                     # spurious epoch_changed resume
+                    latched = self._completed_barriers[(name, epoch)]
                     return {"ok": True, "status": "go",
+                            **({"traceparent": latched}
+                               if isinstance(latched, str) else {}),
                             **self._view_locked()}
                 if epoch != self._epoch:
                     return {"ok": True, "status": "epoch_changed",
                             **self._view_locked()}
+                # causal propagation: the first arrival PRESENTING a
+                # traceparent names this rendezvous's trace, and every
+                # poll reply echoes it — ranks that arrived without a
+                # context adopt it, so one request's trace spans the
+                # whole gang (registered before the formed check: the
+                # early joiner's context must not be lost to a wait)
+                tp = req.get("traceparent")
+                if tracectx.parse_or_none(tp) is not None:
+                    # only a VALID header may occupy the latch: garbage
+                    # must never block a later real context or be echoed
+                    # to the whole gang
+                    self._barrier_traces.setdefault((name, epoch), tp)
+                btp = self._barrier_traces.get((name, epoch))
+                becho = {"traceparent": btp} if btp else {}
                 if len(self._last_hb) + len(self._dead) < self.world:
                     # the gang has not fully formed: a premature barrier
                     # among the early joiners must not "go" before the
                     # remaining ranks exist to be counted
-                    return {"ok": True, "status": "wait",
+                    return {"ok": True, "status": "wait", **becho,
                             **self._view_locked()}
                 arrived = self._barriers.setdefault((name, epoch), {})
                 # first arrival wins: re-polls of a waiting rank must not
@@ -1002,7 +1063,10 @@ class Coordinator:
                 arrived.setdefault(rank, t_recv)
                 if set(self._last_hb) <= set(arrived):
                     del self._barriers[(name, epoch)]
-                    self._completed_barriers[(name, epoch)] = True
+                    self._barrier_traces.pop((name, epoch), None)
+                    # the latch keeps the barrier's trace so stragglers
+                    # polling a completed rendezvous still adopt it
+                    self._completed_barriers[(name, epoch)] = btp or True
                     while len(self._completed_barriers) > 256:
                         self._completed_barriers.pop(
                             next(iter(self._completed_barriers)))
@@ -1014,10 +1078,11 @@ class Coordinator:
                                               "name": name,
                                               "epoch": int(epoch),
                                               "inc": self.incarnation})
-                    self._record_skew_locked(name, epoch, arrived)
-                    return {"ok": True, "status": "go",
+                    self._record_skew_locked(name, epoch, arrived, btp)
+                    return {"ok": True, "status": "go", **becho,
                             **self._view_locked()}
-                return {"ok": True, "status": "wait", **self._view_locked()}
+                return {"ok": True, "status": "wait", **becho,
+                        **self._view_locked()}
             if cmd == "report_failure":
                 peer = req.get("peer")
                 if isinstance(peer, int) and peer in self._last_hb:
@@ -1108,6 +1173,7 @@ class Agent:
         self.clock: Optional[obs_fleet.ClockInfo] = None
         self._telemetry_fn: Optional[Callable[[], Dict]] = None
         self._beat_n = 0  # metrics ship every METRICS_EVERY_BEATS
+        self._barrier_trace: Optional[tracectx.TraceContext] = None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -1562,6 +1628,17 @@ class Agent:
             return self._fenced
 
     @property
+    def barrier_trace(self) -> Optional[tracectx.TraceContext]:
+        """The causal trace context the last rendezvous carried (the
+        first arrival presenting a ``traceparent`` names it; the
+        coordinator echoes it on every poll reply).  A rank arriving
+        WITHOUT its own context adopts this one — ``elastic_run``
+        activates it around the epoch's work, which is how one serve
+        request's trace comes to span every rank of the gang."""
+        with self._lock:
+            return self._barrier_trace
+
+    @property
     def silenced(self) -> bool:
         """True once the ``heartbeat_loss`` fault silenced this agent's
         heartbeats (test-observable only): guards deliberately do NOT
@@ -1593,13 +1670,34 @@ class Agent:
         short RPC per heartbeat interval) so failure detection keeps
         running while we wait; raises `EpochChanged` the moment the
         epoch moves — or if we arrive carrying a stale epoch — and
-        `CoordinatorLost` when the coordinator stops answering."""
+        `CoordinatorLost` when the coordinator stops answering.
+
+        The whole wait is one ``elastic.barrier`` SPAN: the critical-
+        path decomposition (tools/critical_path.py) classifies it as
+        WAIT time, and — when this rank carries a request trace — the
+        span's context is what the barrier verbs present on the wire,
+        so the spans remote ranks stamp under the adopted trace hang
+        directly off this rank's barrier span in the merged tree."""
+        # the span is entered only when the rank can buffer events; the
+        # barrier poll itself stays identical either way
+        with obs_spans.span("elastic.barrier", collective=name,
+                            epoch=epoch, rank=self.rank):
+            return self._barrier_inner(name, epoch)
+
+    def _barrier_inner(self, name: str, epoch: int) -> MemberView:
         fails = 0
         # arrival/departure instants are the raw material of cross-rank
         # skew attribution: after trace_merge aligns the clocks, the
         # spread of `collective.arrive` over ranks decomposes each
         # collective's cost into "own work" vs "waiting for the slowest"
         t_arrive = time.perf_counter_ns()
+        # the adoption latch is per-rendezvous: cleared on entry and
+        # re-latched from this barrier's echo, so a finished request's
+        # trace never leaks into a later untraced run's adoption (a
+        # straggler polling a completed barrier still re-latches — the
+        # coordinator echoes the completed rendezvous's trace)
+        with self._lock:
+            self._barrier_trace = None
         obs_spans.instant("collective.arrive", collective=name,
                           epoch=epoch, rank=self.rank)
         while True:
@@ -1642,12 +1740,24 @@ class Agent:
                 continue
             fails = 0
             self._absorb(resp)
+            btp = tracectx.parse_or_none(resp.get("traceparent"))
+            if btp is not None:
+                with self._lock:
+                    self._barrier_trace = btp
             status = resp.get("status")
             if status == "go":
-                obs_spans.instant(
-                    "collective.depart", collective=name, epoch=epoch,
-                    rank=self.rank,
-                    wait_ns=time.perf_counter_ns() - t_arrive)
+                # the depart instant closes this rank's wait window; when
+                # the rank has no context of its own, it is stamped under
+                # the barrier's adopted trace so the merged timeline
+                # carries the causal edge even before elastic_run
+                # activates the adoption for the epoch's work
+                adopt = (btp or self.barrier_trace) \
+                    if tracectx.current() is None else None
+                with tracectx.activate(adopt):
+                    obs_spans.instant(
+                        "collective.depart", collective=name, epoch=epoch,
+                        rank=self.rank,
+                        wait_ns=time.perf_counter_ns() - t_arrive)
                 return self.view()
             if status in ("epoch_changed", "rejected"):
                 obs_spans.instant("elastic.straggler_rejected"
@@ -1756,6 +1866,12 @@ def elastic_run(agent: Agent, n_parts: int,
         obs_fleet.set_run_id(run_id)
     agent.wait_formed()
     max_iters = 4 * max(agent.view().world, 1) + 8
+    # cross-rank causal adoption: when this rank has no trace context of
+    # its own and a rendezvous carried one (a peer rooted in a serve
+    # request or an ambient CYLON_TPU_TRACEPARENT), the epoch's work —
+    # passes, shuffles, journal IO — runs as a CHILD of that trace, so
+    # one request yields one causally-linked trace across the whole gang
+    adopted: Optional[tracectx.TraceContext] = None
     with obs_spans.span("elastic.run", rank=agent.rank, n_parts=n_parts):
         for _ in range(max_iters):
             try:
@@ -1774,12 +1890,22 @@ def elastic_run(agent: Agent, n_parts: int,
                 # the merged timeline even for runs a straggler never
                 # finishes
                 agent.barrier(f"{barrier_name}/start", view.epoch)
-                sl = ElasticSlice(
-                    parts=owned_parts(n_parts, agent.rank, view.members),
-                    epoch=view.epoch, world=len(view.members),
-                    guard=_make_guard(agent, view.epoch))
-                run_parts(sl)
-                agent.barrier(barrier_name, view.epoch)
+                if adopted is None and tracectx.current() is None:
+                    # adopt the barrier's context AS-IS (no child hop):
+                    # spans this rank records become direct children of
+                    # the span that presented the traceparent on the
+                    # requesting rank, so the merged tree is walkable
+                    # edge by edge — a synthetic intermediate span_id
+                    # with no event would orphan the whole subtree
+                    adopted = agent.barrier_trace
+                with tracectx.activate(adopted):
+                    sl = ElasticSlice(
+                        parts=owned_parts(n_parts, agent.rank,
+                                          view.members),
+                        epoch=view.epoch, world=len(view.members),
+                        guard=_make_guard(agent, view.epoch))
+                    run_parts(sl)
+                    agent.barrier(barrier_name, view.epoch)
             except EpochChanged as e:
                 # fencing dominates the membership check: a straggler
                 # whose survivors ALREADY finished and left sees an
@@ -1803,7 +1929,11 @@ def elastic_run(agent: Agent, n_parts: int,
                             "(was %d): %s", agent.rank, agent.epoch,
                             view.epoch, e.msg)
                 continue
-            return finalize() if finalize is not None else None
+            with tracectx.activate(adopted):
+                # the adopted context covers finalize too: journal
+                # consumption assembling the result is the request's
+                # work, and its stats carry the trace_id
+                return finalize() if finalize is not None else None
     raise CylonError(
         Code.ExecutionError,
         f"elastic run did not stabilize after {resumes} membership "
@@ -1815,6 +1945,13 @@ def _make_guard(agent: Agent, epoch: int) -> Callable[[], None]:
     fault probe runs FIRST so ``rank_kill`` fires at exactly the pass
     boundary a preemption would."""
     def guard() -> None:
-        resilience.fault_point(f"elastic.pass.r{agent.rank}")
-        agent.ensure_epoch(epoch)
+        # the guard is a SPAN, not free time: an injected `delay` fault
+        # (the seeded-straggler harness) sleeps inside the fault probe,
+        # and without a span that sleep would be an unattributable gap
+        # on the slow rank's timeline — exactly the segment the
+        # critical-path decomposition must be able to name
+        with obs_spans.span("elastic.pass_guard", rank=agent.rank,
+                            epoch=epoch):
+            resilience.fault_point(f"elastic.pass.r{agent.rank}")
+            agent.ensure_epoch(epoch)
     return guard

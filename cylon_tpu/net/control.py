@@ -23,6 +23,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
+from ..obs import tracectx
 from ..status import Status
 
 MAX_LINE = 1 << 20  # a control message is small; a longer line is a bug
@@ -76,7 +77,12 @@ def request(address: Tuple[str, int], obj: Dict,
     ``OSError`` unchanged (incl. ``ConnectionRefusedError`` and
     ``socket.timeout``) — the caller owns terminal classification (the
     elastic agent turns repeated failures into coordinator loss).
+
+    The active trace context (obs.tracectx) rides every verb as a
+    ``traceparent`` field, so coordinator-side spans and remote ranks
+    join the requester's causal trace; a caller-supplied field wins.
     """
+    obj = tracectx.attach_wire(obj)
     attempt = 0
     while True:
         try:
@@ -143,7 +149,16 @@ class JsonServer:
             except (OSError, ValueError):
                 return  # malformed/garbled request: drop the connection
             try:
-                resp = self._handler(req)
+                # a verb carrying a traceparent runs its handler under
+                # that context (as a child span of the caller's), so
+                # every obs instant the handler records — rendezvous
+                # skew, rank loss, fencing — is stamped with the
+                # requester's trace.  A garbled header means "no trace",
+                # never a failed verb.
+                ctx = tracectx.parse_or_none(req.get("traceparent"))
+                with tracectx.activate(
+                        ctx.child() if ctx is not None else None):
+                    resp = self._handler(req)
             except Exception as e:
                 resp = {"ok": False,
                         "error": f"{type(e).__name__}: {e}"}

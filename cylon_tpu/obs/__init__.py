@@ -20,4 +20,5 @@ from . import export  # noqa: F401
 from . import fleet  # noqa: F401
 from . import metrics  # noqa: F401
 from . import spans  # noqa: F401
+from . import tracectx  # noqa: F401
 from .spans import instant, span  # noqa: F401

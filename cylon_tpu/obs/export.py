@@ -83,6 +83,12 @@ def _event_json(ev: spans_mod.Event, pid: int) -> Dict[str, object]:
     args: Dict[str, object] = {"depth": ev.depth}
     if ev.attrs:
         args.update(ev.attrs)
+    if ev.trace is not None:
+        # the causal identity triple (obs.tracectx): the edges
+        # tools/critical_path.py walks and Perfetto queries can group on
+        args["trace_id"], args["span_id"] = ev.trace[0], ev.trace[1]
+        if ev.trace[2]:
+            args["parent_span_id"] = ev.trace[2]
     out["args"] = args
     return out
 

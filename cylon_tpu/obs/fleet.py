@@ -52,6 +52,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .. import config
 from . import metrics as metrics_mod
 from . import spans as spans_mod
+from . import tracectx
 
 log = logging.getLogger("cylon_tpu")
 
@@ -300,11 +301,18 @@ def flight_record(reason: str, *, rank=None, run_id: Optional[str] = None,
         from . import export as export_mod  # no cycle at call time
 
         pid = r if isinstance(r, int) else 0
+        # the active (or explicitly attributed) request trace: a flight
+        # dump can then be JOINED to the request trace that died — the
+        # post-mortem's missing causal edge before PR 13
+        tctx = tracectx.current()
+        trace_id = entry["attrs"].get("trace_id") or (
+            tctx.trace_id if tctx is not None else None)
         doc = {
             "kind": FLIGHT_KIND,
             "run_id": str(rid),
             "rank": r,
             "reason": reason,
+            "trace_id": trace_id,
             "attrs": entry["attrs"],
             "terminal_events": reasons,
             "clock": clock_dict(),

@@ -144,13 +144,66 @@ def _render_into(lines: List[str], snapshot: Dict,
         lines.append(f"{name}_count{_labels(lab)} {count}")
 
 
+def _pkg_version() -> str:
+    """The package version for the build-info gauge, resolved lazily so
+    this module never imports the (heavy) package root."""
+    import sys as _sys
+
+    v = getattr(_sys.modules.get("cylon_tpu"), "__version__", None)
+    return str(v) if v else "unknown"
+
+
+def _append_build_info(lines: List[str], typed: Dict[str, str],
+                       extra_labels: List[Tuple[str, str]]) -> None:
+    """The ``cylon_tpu_build_info`` info-style gauge (value always 1;
+    identity rides the labels): version, rank and the last-observed
+    coordinator incarnation — so a scrape pipeline can tell WHICH build
+    and WHICH coordinator lifetime every other sample belongs to."""
+    from . import export as export_mod
+    from . import fleet as fleet_mod
+
+    name = PREFIX + "build_info"
+    if name not in typed:
+        typed[name] = "gauge"
+        lines.append(f"# TYPE {name} gauge")
+    inc = fleet_mod.current_incarnation()
+    lab = list(extra_labels) + [
+        ("version", _pkg_version()),
+        ("rank", str(fleet_mod.current_rank()
+                     if fleet_mod.current_rank() is not None
+                     else export_mod.default_rank())),
+        ("incarnation", str(inc if inc is not None else -1)),
+    ]
+    lines.append(f"{name}{_labels(lab)} 1")
+
+
+#: counters a scrape must ALWAYS see, zero-valued before first increment:
+#: the tail-retention pair — a dashboard alerting on retention behavior
+#: must be able to distinguish "no requests closed yet" (both zero) from
+#: "the counters don't exist" (a broken deploy)
+_ALWAYS_COUNTERS = ("trace.tail_kept", "trace.tail_dropped")
+
+
+def _with_always_counters(snap: Dict) -> Dict:
+    counters = dict(snap.get("counters") or {})
+    if all(k in counters for k in _ALWAYS_COUNTERS):
+        return snap
+    return {**snap,
+            "counters": {**{k: 0 for k in _ALWAYS_COUNTERS}, **counters}}
+
+
 def render(snapshot: Optional[Dict] = None) -> str:
     """One process's metrics snapshot as exposition text (terminated by
     the OpenMetrics ``# EOF`` marker, which Prometheus' text parser
-    treats as a comment)."""
-    snap = metrics_mod.snapshot() if snapshot is None else snapshot
+    treats as a comment).  Always carries the ``cylon_tpu_build_info``
+    identity gauge and the ``trace.tail_kept``/``trace.tail_dropped``
+    retention pair, even over an empty registry."""
+    snap = _with_always_counters(
+        metrics_mod.snapshot() if snapshot is None else snapshot)
     lines: List[str] = []
-    _render_into(lines, snap, [], {})
+    typed: Dict[str, str] = {}
+    _append_build_info(lines, typed, [])
+    _render_into(lines, snap, [], typed)
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -158,11 +211,18 @@ def render(snapshot: Optional[Dict] = None) -> str:
 def render_fleet(snapshots: Dict[str, Dict]) -> str:
     """Per-rank snapshots (the coordinator's heartbeat-shipped ledger)
     as ONE exposition document, every sample labeled ``rank``.  Ranks
-    render in sorted order; each metric's ``# TYPE`` appears once."""
+    render in sorted order; each metric's ``# TYPE`` appears once.
+    Carries the same always-on surface as :func:`render`: the rendering
+    process's ``build_info`` identity gauge (per-rank versions are not
+    shipped over heartbeats — the coordinator's identity stands in) and
+    the zero-valued retention counter pair PER RANK, so the fleet
+    scrape distinguishes "no requests closed on rank N" from a broken
+    deploy exactly like the per-process one."""
     lines: List[str] = []
     typed: Dict[str, str] = {}
+    _append_build_info(lines, typed, [])
     for rank in sorted(snapshots, key=str):
-        _render_into(lines, snapshots[rank] or {},
+        _render_into(lines, _with_always_counters(snapshots[rank] or {}),
                      [("rank", str(rank))], typed)
     lines.append("# EOF")
     return "\n".join(lines) + "\n"

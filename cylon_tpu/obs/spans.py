@@ -41,6 +41,7 @@ from collections import deque
 from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 from .. import config
+from . import tracectx
 
 log = logging.getLogger("cylon_tpu")
 
@@ -55,7 +56,9 @@ _MODE_OF = {"0": OFF, "off": OFF, "auto": AGGREGATE,
 class Event(NamedTuple):
     """One buffered trace event.  ``ts``/``dur`` are monotonic
     nanoseconds (``time.perf_counter_ns``); ``ph`` is the Chrome-trace
-    phase — "X" complete span, "i" instant."""
+    phase — "X" complete span, "i" instant.  ``trace`` is the causal
+    identity triple ``(trace_id, span_id, parent_span_id)`` when a
+    request context (obs.tracectx) was active, else None."""
 
     name: str
     ts: int
@@ -64,10 +67,16 @@ class Event(NamedTuple):
     depth: int
     ph: str
     attrs: Optional[Dict[str, object]]
+    trace: Optional[Tuple[str, str, Optional[str]]] = None
 
 
 _events: List[Event] = []
 _dropped = 0
+# guards buffer membership (record vs retention discard): only taken
+# when event buffering is ON — the aggregate-only default never touches
+# it.  Readers (events(), exports) stay lock-free: tuple(_events) is one
+# GIL-atomic C call and the list is only ever appended or rebuilt whole.
+_buf_lock = threading.Lock()
 _totals: Dict[str, float] = {}
 _counts: Dict[str, int] = {}
 _tls = threading.local()
@@ -157,10 +166,11 @@ def _fence() -> None:
 
 def _record(ev: Event) -> None:
     global _dropped
-    if len(_events) >= buffer_cap():
-        _dropped += 1
-        return
-    _events.append(ev)
+    with _buf_lock:
+        if len(_events) >= buffer_cap():
+            _dropped += 1
+            return
+        _events.append(ev)
 
 
 class _NullSpan:
@@ -184,7 +194,8 @@ _NULL = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "_t0", "_d", "_buffer", "_sync", "_ring")
+    __slots__ = ("name", "attrs", "_t0", "_d", "_buffer", "_sync", "_ring",
+                 "_trace")
 
     def __init__(self, name: str, attrs: Optional[Dict[str, object]],
                  buffer: bool, sync: bool, ring: bool):
@@ -193,6 +204,7 @@ class _Span:
         self._buffer = buffer
         self._sync = sync
         self._ring = ring
+        self._trace = None
 
     def set(self, **attrs) -> "_Span":
         """Attach/refresh attributes after entry (e.g. a row count known
@@ -205,6 +217,10 @@ class _Span:
     def __enter__(self) -> "_Span":
         if self._sync:
             _fence()
+        if self._buffer or self._ring:
+            # causal identity: become a child span of the active request
+            # context (None — the common case — costs one contextvar read)
+            self._trace = tracectx.push_span()
         self._d = _depth()
         _tls.depth = self._d + 1
         self._t0 = time.perf_counter_ns()
@@ -219,8 +235,13 @@ class _Span:
         _totals[self.name] = _totals.get(self.name, 0.0) + dur * 1e-9
         _counts[self.name] = _counts.get(self.name, 0) + 1
         if self._buffer or self._ring:
+            tr = None
+            if self._trace is not None:
+                ctx, tok = self._trace
+                tracectx.pop_span(tok)
+                tr = ctx.triple()
             ev = Event(self.name, self._t0, dur,
-                       threading.get_ident(), self._d, "X", self.attrs)
+                       threading.get_ident(), self._d, "X", self.attrs, tr)
             if self._buffer:
                 _record(ev)
             if self._ring:
@@ -256,8 +277,10 @@ def instant(name: str, **attrs) -> None:
     _counts[name] = _counts.get(name, 0) + 1
     _totals.setdefault(name, 0.0)
     if m == EVENTS or ring_cap() > 0:
+        c = tracectx.current()
         ev = Event(name, time.perf_counter_ns(), 0,
-                   threading.get_ident(), _depth(), "i", attrs or None)
+                   threading.get_ident(), _depth(), "i", attrs or None,
+                   None if c is None else c.triple())
         if m == EVENTS:
             _record(ev)
         _ring_record(ev)
@@ -266,6 +289,23 @@ def instant(name: str, **attrs) -> None:
 def events() -> Tuple[Event, ...]:
     """Snapshot of the buffered events, in record order."""
     return tuple(_events)
+
+
+def discard_trace(trace_id: str) -> int:
+    """Tail-based retention's discard half: remove buffered events
+    stamped with ``trace_id`` (a fast-and-healthy request closing), and
+    return how many were removed.  The flight ring is deliberately
+    untouched (a post-mortem wants the most recent events whoever owned
+    them) and the drop counter is MONOTONE — retention discards are
+    accounted separately (``trace.tail_dropped``), never by un-counting
+    overflow drops.  One O(buffer) rebuild under the record lock, so a
+    concurrent request's append can never be lost mid-rebuild; the cost
+    is bounded by the buffer cap and paid only on a losing close."""
+    with _buf_lock:
+        before = len(_events)
+        _events[:] = [e for e in _events
+                      if e.trace is None or e.trace[0] != trace_id]
+        return before - len(_events)
 
 
 def dropped() -> int:

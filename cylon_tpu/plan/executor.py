@@ -134,6 +134,13 @@ def execute(plan: "ir.LogicalPlan", ctx=None, pass_guard=None,
         # same line)
         st = Status.from_exception(e)
         if st.code not in (Code.EpochMismatch, Code.Cancelled):
+            # terminal instant + flight dump, both stamped with the
+            # active request trace (the instant via the ambient context,
+            # the dump via flight_record's trace capture), so the
+            # post-mortem joins to the request that died here
+            obs_spans.instant("plan.fatal", code=st.code.name,
+                              fingerprint=fp[:12] if fp else None,
+                              world=world)
             obs_fleet.flight_record(
                 "plan_fatal", code=st.code.name,
                 fingerprint=fp[:12] if fp else None, world=world,

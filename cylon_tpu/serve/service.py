@@ -72,6 +72,7 @@ from .. import resilience
 from ..obs import fleet as obs_fleet
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
+from ..obs import tracectx
 from ..status import Code, CylonError, Status
 from . import cache as cache_mod
 
@@ -156,6 +157,16 @@ _RUNNERS = {
     "plan": _run_plan,
 }
 
+
+def register_op(op: str, runner) -> None:
+    """Register a custom serve op: ``runner(*args, ctx=, pass_guard=,
+    **kwargs) -> (result, stats)``.  The runner executes on the
+    scheduler thread under the request's trace context, with the same
+    cancellation/deadline guard every built-in op gets — the extension
+    point the cross-rank tracing smoke uses to drive an elastic gang
+    from one serve request."""
+    _RUNNERS[str(op)] = runner
+
 QUEUED = "queued"
 RUNNING = "running"
 DONE = "done"
@@ -177,10 +188,13 @@ class TenantBudget:
 
 class Ticket:
     """One admitted request: a caller-side handle carrying the result
-    event, the terminal state, and the cancel signal."""
+    event, the terminal state, the cancel signal, and the request's
+    causal trace context (``trace.trace_id`` joins this request to its
+    spans across every rank it touched)."""
 
     def __init__(self, service: "QueryService", tenant: str, op: str,
-                 args, kwargs):
+                 args, kwargs,
+                 trace: Optional[tracectx.TraceContext] = None):
         self._service = service
         self.tenant = tenant
         self.op = op
@@ -194,8 +208,14 @@ class Ticket:
         self.duration_s: Optional[float] = None
         self.queue_wait_s: Optional[float] = None
         self.t_submit = time.perf_counter()
+        self.trace = trace
+        self._trace_closed = False
         self._event = threading.Event()
         self._cancel = threading.Event()
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
 
     @property
     def done(self) -> bool:
@@ -227,6 +247,19 @@ class Ticket:
         self.result_value = result
         self.stats = stats
         self.error = error
+        # EVERY terminal path — completed, failed, cancelled, shed —
+        # closes the request's trace exactly once: the tail-retention
+        # decision runs here (keep the buffered events, or discard them
+        # and keep only the aggregate stopwatch).  Anything that did not
+        # complete counts as "failed" for retention — a cancelled or
+        # shed request's trace is precisely what the caller will ask
+        # about.
+        if self.trace is not None and not self._trace_closed:
+            self._trace_closed = True
+            dur = self.duration_s if self.duration_s is not None \
+                else max(0.0, time.perf_counter() - self.t_submit)
+            tracectx.finish_request(self.trace, dur * 1e3,
+                                    failed=state != DONE)
         self._event.set()
 
 
@@ -342,13 +375,19 @@ class QueryService:
         return max(0.05, per * max(1, ahead))
 
     def _shed(self, tenant: str, code: Code, reason: str,
-              retry_after: Optional[float]) -> CylonError:
+              retry_after: Optional[float],
+              trace: Optional[tracectx.TraceContext] = None) -> CylonError:
         st = self._tenant(tenant)
         st.shed += 1
         self._counts["shed"] += 1
         obs_metrics.counter_add("serve.shed")
-        obs_spans.instant("serve.shed", tenant=tenant, code=code.name,
-                          reason=reason)
+        # the shed instant is stamped under the request's trace (the
+        # caller's thread has no ambient context during submit — the
+        # trace was only just minted), so a shed request's terminal
+        # instant joins the trace the caller was handed
+        with tracectx.activate(trace):
+            obs_spans.instant("serve.shed", tenant=tenant, code=code.name,
+                              reason=reason)
         # a shed is a classified terminal event for the caller: the
         # flight dump records the admission state that forced it —
         # STAGED here (every _shed call site holds the service lock) and
@@ -356,7 +395,8 @@ class QueryService:
         # serializes admission under the exact overload being recorded
         self._pending_flight.append(dict(
             tenant=tenant, code=code.name, shed_reason=reason,
-            queue_depth=len(self._queue)))
+            queue_depth=len(self._queue),
+            **({"trace_id": trace.trace_id} if trace is not None else {})))
         hint = "" if retry_after is None else f"; retry after ~{retry_after:.2f}s"
         return CylonError(code, f"request shed for tenant {tenant!r}: "
                                 f"{reason}{hint}",
@@ -389,6 +429,23 @@ class QueryService:
         if op not in _RUNNERS:
             raise CylonError(Code.Invalid,
                              f"unknown op {op!r} (expected one of {OPS})")
+        # mint the request's causal trace BEFORE any admission decision,
+        # so even a shed request has an identity the caller can chase
+        # through the merged timeline.  A client-supplied ``traceparent=``
+        # (the W3C wire form) is adopted as the parent — the request
+        # becomes a child span of the caller's own trace; a malformed
+        # header is rejected leniently (fresh trace, never a failed
+        # submit).
+        parent = tracectx.parse_or_none(kwargs.pop("traceparent", None))
+        trace = parent.child() if parent is not None \
+            else tracectx.new_trace()
+
+        def shed_now(err: CylonError) -> CylonError:
+            # an admission shed has no Ticket to close the trace through:
+            # close it here (duration = time spent in admission, ~0)
+            tracectx.finish_request(trace, 0.0, failed=True)
+            return err
+
         est = _estimate_request_bytes(args, kwargs)
         try:
             resilience.fault_point("serve.admit")
@@ -398,19 +455,22 @@ class QueryService:
             with self._lock:
                 err = self._shed(tenant, Code.ResourceExhausted,
                                  Status.from_exception(e).msg,
-                                 self._retry_after(len(self._queue) + 1))
-            raise err
+                                 self._retry_after(len(self._queue) + 1),
+                                 trace)
+            raise shed_now(err)
         with self._lock:
             if self._closed or self._draining:
-                raise self._shed(tenant, Code.Unavailable,
-                                 "service is draining", None)
+                raise shed_now(self._shed(tenant, Code.Unavailable,
+                                          "service is draining", None,
+                                          trace))
             st = self._tenant(tenant)
             now = time.monotonic()
             if st.quarantined_until > now:
-                raise self._shed(tenant, Code.Unavailable,
-                                 f"tenant quarantined after {st.streak} "
-                                 f"consecutive failures",
-                                 st.quarantined_until - now)
+                raise shed_now(self._shed(
+                    tenant, Code.Unavailable,
+                    f"tenant quarantined after {st.streak} "
+                    f"consecutive failures",
+                    st.quarantined_until - now, trace))
             if st.quarantined_until:
                 # cooldown elapsed: the tenant re-enters with a CLEAN
                 # failure streak (the knob's contract) — otherwise one
@@ -420,30 +480,32 @@ class QueryService:
                 st.streak = 0
             depth = len(self._queue) + (1 if self._running is not None else 0)
             if len(self._queue) >= self._cap:
-                raise self._shed(tenant, Code.ResourceExhausted,
-                                 f"admission queue full "
-                                 f"({len(self._queue)}/{self._cap})",
-                                 self._retry_after(depth + 1))
+                raise shed_now(self._shed(
+                    tenant, Code.ResourceExhausted,
+                    f"admission queue full "
+                    f"({len(self._queue)}/{self._cap})",
+                    self._retry_after(depth + 1), trace))
             budget = self._budgets.get(tenant)
             tcap = budget.max_queued if budget is not None \
                 and budget.max_queued is not None \
                 else max(1, int(-(-self._cap * tenant_share() // 1)))
             if st.queued >= tcap:
-                raise self._shed(tenant, Code.ResourceExhausted,
-                                 f"tenant queue share full "
-                                 f"({st.queued}/{tcap} of {self._cap})",
-                                 self._retry_after(st.queued + 1))
+                raise shed_now(self._shed(
+                    tenant, Code.ResourceExhausted,
+                    f"tenant queue share full "
+                    f"({st.queued}/{tcap} of {self._cap})",
+                    self._retry_after(st.queued + 1), trace))
             hbm_cap = budget.hbm_bytes if budget is not None \
                 and budget.hbm_bytes is not None else hbm_budget_bytes()
             if hbm_cap > 0:
                 live = obs_metrics.record_hbm_watermark()
                 if est + live > hbm_cap:
-                    raise self._shed(
+                    raise shed_now(self._shed(
                         tenant, Code.ResourceExhausted,
                         f"HBM admission estimate {est} + live {live} "
                         f"exceeds the {hbm_cap}-byte tenant budget",
-                        self._retry_after(depth + 1))
-            ticket = Ticket(self, tenant, op, args, kwargs)
+                        self._retry_after(depth + 1), trace))
+            ticket = Ticket(self, tenant, op, args, kwargs, trace=trace)
             self._queue.append(ticket)
             st.queued += 1
             st.admitted += 1
@@ -512,7 +574,7 @@ class QueryService:
             with self._lock:
                 err = self._shed(ticket.tenant, Code.Unavailable,
                                  Status.from_exception(e).msg,
-                                 self._retry_after(1))
+                                 self._retry_after(1), ticket.trace)
                 self._running = None
                 self._lock.notify_all()
             ticket._finish(SHED, error=err)
@@ -579,14 +641,26 @@ class QueryService:
         obs_metrics.hist_observe(_slo_key("queue_wait_ms", tenant),
                                  ticket.queue_wait_s * 1e3)
         runner = _RUNNERS[ticket.op]
-        with obs_spans.span("serve.request", tenant=tenant,
-                            op=ticket.op) as sp:
+        # the request's trace context is ACTIVE for the whole execution:
+        # every span the engine records on this thread (plan passes,
+        # exec passes, shuffle collectives) becomes a child span of this
+        # request, and every control verb the run issues (barriers,
+        # heartbeat-adjacent RPCs) carries its traceparent — which is
+        # how one serve request comes to own a cross-rank trace
+        with tracectx.activate(ticket.trace), \
+                obs_spans.span("serve.request", tenant=tenant,
+                               op=ticket.op) as sp:
             try:
                 with (dl if dl is not None else contextlib.nullcontext()):
                     result, stats = runner(*ticket.args, ctx=self._ctx,
                                            pass_guard=guard,
                                            **ticket.kwargs)
             except Exception as e:
+                # duration BEFORE _finish_failed closes the trace: the
+                # tail-retention p99 estimator must see run time, never
+                # queue wait + run (the except body runs ahead of the
+                # finally that normally stamps it)
+                ticket.duration_s = time.perf_counter() - t0
                 self._finish_failed(ticket, e)
                 return
             finally:
@@ -652,7 +726,9 @@ class QueryService:
         obs_fleet.flight_record("request_failed", tenant=ticket.tenant,
                                 op=ticket.op, code=err.code.name,
                                 quarantined=quarantined,
-                                error=err.msg[:200])
+                                error=err.msg[:200],
+                                **({"trace_id": ticket.trace.trace_id}
+                                   if ticket.trace is not None else {}))
         ticket._finish(FAILED, error=err)
 
     # -- drain / close ------------------------------------------------------
@@ -670,7 +746,7 @@ class QueryService:
                 st = self._tenant(t.tenant)
                 st.queued -= 1
                 err = self._shed(t.tenant, Code.Unavailable,
-                                 "service draining", None)
+                                 "service draining", None, t.trace)
                 t._finish(SHED, error=err)
             obs_metrics.gauge_set("serve.queue_depth", 0)
             deadline = None if timeout is None \

@@ -1,0 +1,687 @@
+"""Causal request tracing (cylon_tpu/obs/tracectx.py + PR-13 wiring).
+
+Contract pinned here: a W3C traceparent round-trips and every garbled
+form is rejected (fuzz matrix); spans entered under an active context
+become child spans and their buffered events carry the causal triple;
+the propagation matrix holds — serve→plan/exec→shuffle on one thread,
+serve→elastic barrier across the coordinator wire (remote ranks ADOPT
+the requester's trace), and cancelled + shed requests still close their
+trace; tail-based retention keeps slow/failed/sampled requests and
+discards fast-and-healthy ones WITHOUT touching the overflow drop
+counter (monotone), so a sampled-slow request's buffer survives a flood
+of fast ones; the critical-path walk tiles a request wall end to end,
+redirects waits through overlapping remote work, and names the dominant
+segment; terminal instants (deadline.fired, serve.shed) and flight
+dumps carry the trace id that died.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cylon_tpu import config, durable, elastic
+from cylon_tpu.net import control
+from cylon_tpu.obs import export as obs_export
+from cylon_tpu.obs import fleet as obs_fleet
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.obs import openmetrics
+from cylon_tpu.obs import spans as obs_spans
+from cylon_tpu.obs import tracectx
+from cylon_tpu.serve import QueryService
+from cylon_tpu.serve import service as service_mod
+from cylon_tpu.status import Code, CylonError
+
+WAIT_S = 180.0
+
+HB = dict(interval_s=0.05, timeout_s=0.5, reconnect_s=0.0)
+HB_TIMEOUT = 0.4
+
+
+@pytest.fixture()
+def clean_trace():
+    obs_spans.reset()
+    obs_metrics.reset()
+    tracectx.reset()
+    yield
+    obs_spans.reset()
+    obs_metrics.reset()
+    tracectx.reset()
+
+
+def _inputs(seed, n=1200):
+    rng = np.random.default_rng(seed)
+    left = {"k": rng.integers(0, n, n).astype(np.int64),
+            "a": rng.random(n).astype(np.float32)}
+    right = {"k": rng.integers(0, n, n).astype(np.int64),
+             "b": rng.random(n).astype(np.float32)}
+    return left, right
+
+
+def _counter(name: str) -> float:
+    return obs_metrics.snapshot()["counters"].get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# traceparent parse / reject fuzz
+# ---------------------------------------------------------------------------
+
+def test_traceparent_roundtrip():
+    ctx = tracectx.new_trace(sampled=True)
+    back = tracectx.parse_traceparent(ctx.traceparent())
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    assert back.parent_span_id is None
+    unsampled = tracectx.new_trace(sampled=False)
+    assert unsampled.traceparent().endswith("-00")
+    assert tracectx.parse_traceparent(
+        unsampled.traceparent()).sampled is False
+
+
+VALID = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "00",
+    VALID[:-1],                              # truncated flags
+    VALID + "0",                             # trailing garbage
+    VALID + "-extra",                        # extra field
+    VALID.replace("-", "_", 1),              # wrong separator
+    VALID.upper(),                           # uppercase hex forbidden
+    "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",   # version ff forbidden
+    "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",   # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "00" * 8 + "-01",   # all-zero span id
+    "00-" + "ab" * 15 + "-" + "cd" * 8 + "-01",   # short trace id
+    "00-" + "ab" * 16 + "-" + "cd" * 7 + "-01",   # short span id
+    "00-" + "gg" * 16 + "-" + "cd" * 8 + "-01",   # non-hex
+    "00 - " + "ab" * 16 + " - " + "cd" * 8 + " - 01",
+    "traceparent: " + VALID,
+])
+def test_traceparent_fuzz_rejected(bad):
+    with pytest.raises(ValueError):
+        tracectx.parse_traceparent(bad)
+    assert tracectx.parse_or_none(bad) is None
+
+
+@pytest.mark.parametrize("notstr", [None, 7, b"00-aa-bb-01", ["x"], {}])
+def test_traceparent_non_string_rejected(notstr):
+    with pytest.raises(ValueError):
+        tracectx.parse_traceparent(notstr)
+    assert tracectx.parse_or_none(notstr) is None
+
+
+def test_traceparent_unknown_version_accepted():
+    # W3C forward compat: any version but ff parses (fields are fixed
+    # width at version 00's layout, which future versions must prefix)
+    got = tracectx.parse_traceparent("cc-" + "ab" * 16 + "-"
+                                     + "cd" * 8 + "-00")
+    assert got.trace_id == "ab" * 16 and got.sampled is False
+
+
+def test_child_keeps_trace_links_parent():
+    root = tracectx.new_trace(sampled=True)
+    kid = root.child()
+    assert kid.trace_id == root.trace_id
+    assert kid.parent_span_id == root.span_id
+    assert kid.span_id != root.span_id
+    assert kid.sampled is True
+
+
+# ---------------------------------------------------------------------------
+# span stamping (the causal triple on buffered events)
+# ---------------------------------------------------------------------------
+
+def test_spans_stamped_under_active_context(clean_trace):
+    ctx = tracectx.new_trace()
+    with config.knob_env(CYLON_TPU_TRACE="1"):
+        with tracectx.activate(ctx):
+            with obs_spans.span("outer"):
+                with obs_spans.span("inner"):
+                    pass
+                obs_spans.instant("tick")
+        obs_spans.instant("outside")
+    by_name = {e.name: e for e in obs_spans.events()}
+    outer, inner, tick = (by_name["outer"], by_name["inner"],
+                          by_name["tick"])
+    assert outer.trace[0] == inner.trace[0] == tick.trace[0] == ctx.trace_id
+    # causal edges: outer hangs off the minted context, inner off outer,
+    # and the instant is stamped with the ENCLOSING span's identity
+    assert outer.trace[2] == ctx.span_id
+    assert inner.trace[2] == outer.trace[1]
+    # the instant fires after inner closed: stamped with the ENCLOSING
+    # (outer) span's identity
+    assert tick.trace[1] == outer.trace[1]
+    # no context, no triple — and the export carries the stamp
+    assert by_name["outside"].trace is None
+    path = obs_export.export_trace(path="/tmp/trace_stamp_test.json")
+    doc = obs_export.load_trace(path)
+    args = {e["name"]: e.get("args", {}) for e in doc["traceEvents"]}
+    assert args["outer"]["trace_id"] == ctx.trace_id
+    assert args["inner"]["parent_span_id"] == args["outer"]["span_id"]
+    assert "trace_id" not in args["outside"]
+
+
+def test_ambient_traceparent_roots_process(clean_trace):
+    ctx = tracectx.new_trace()
+    with config.knob_env(CYLON_TPU_TRACE="1",
+                         CYLON_TPU_TRACEPARENT=ctx.traceparent()):
+        assert tracectx.current().trace_id == ctx.trace_id
+        with obs_spans.span("ambient.work"):
+            pass
+    ev = obs_spans.events()[0]
+    assert ev.trace[0] == ctx.trace_id
+    # a garbled ambient header means "no trace", never a crash
+    with config.knob_env(CYLON_TPU_TRACE="1",
+                         CYLON_TPU_TRACEPARENT="garbage"):
+        assert tracectx.current() is None
+
+
+# ---------------------------------------------------------------------------
+# tail-based retention
+# ---------------------------------------------------------------------------
+
+def test_tail_retention_off_keeps_everything(clean_trace):
+    ctx = tracectx.new_trace()
+    with config.knob_env(CYLON_TPU_TRACE_TAIL_MS="0"):
+        assert tracectx.tail_keep(ctx, 0.001) is True
+        assert tracectx.finish_request(ctx, 0.001) is True
+    # the counters describe RETENTION decisions: with retention off they
+    # stay zero (zero-valued-but-present in the exposition is the
+    # "disabled or idle" state; a missing counter is a broken deploy)
+    assert _counter("trace.tail_kept") == 0
+    assert _counter("trace.tail_dropped") == 0
+
+
+def test_tail_retention_keeps_slow_failed_sampled(clean_trace):
+    with config.knob_env(CYLON_TPU_TRACE_TAIL_MS="50"):
+        fast = tracectx.new_trace(sampled=False)
+        assert tracectx.finish_request(fast, 1.0) is False
+        slow = tracectx.new_trace(sampled=False)
+        assert tracectx.finish_request(slow, 80.0) is True
+        failed = tracectx.new_trace(sampled=False)
+        assert tracectx.finish_request(failed, 1.0, failed=True) is True
+        sampled = tracectx.new_trace(sampled=True)
+        assert tracectx.finish_request(sampled, 1.0) is True
+    assert _counter("trace.tail_kept") == 3
+    assert _counter("trace.tail_dropped") == 1
+
+
+def test_tail_retention_p99_estimate_kicks_in(clean_trace):
+    # far-below-threshold requests: only the rolling p99 can keep one,
+    # and only after P99_MIN_SAMPLES closes (before that every request
+    # would read as "above p99" and retention would keep everything)
+    with config.knob_env(CYLON_TPU_TRACE_TAIL_MS="100000"):
+        early = tracectx.new_trace()
+        assert tracectx.tail_keep(early, 50.0) is False
+        for _ in range(tracectx.P99_MIN_SAMPLES):
+            tracectx.tail_keep(tracectx.new_trace(), 1.0)
+        outlier = tracectx.new_trace()
+        assert tracectx.tail_keep(outlier, 50.0) is True
+        typical = tracectx.new_trace()
+        assert tracectx.tail_keep(typical, 0.5) is False
+
+
+def test_shed_storm_does_not_poison_p99_estimator(clean_trace):
+    """Admission sheds close at ~0 ms with failed=True; a storm of them
+    must NOT decay the rolling p99 toward zero (which would make every
+    fast-and-healthy request read as "slow" and flood the buffer —
+    exactly the failure mode tail retention exists to prevent)."""
+    with config.knob_env(CYLON_TPU_TRACE_TAIL_MS="100000"):
+        for _ in range(tracectx.P99_MIN_SAMPLES + 4):
+            tracectx.tail_keep(tracectx.new_trace(), 10.0)
+        before = tracectx.p99_estimate_ms()
+        for _ in range(500):  # a shed storm at queue cap
+            assert tracectx.finish_request(
+                tracectx.new_trace(), 0.0, failed=True) is True
+        assert tracectx.p99_estimate_ms() == before
+        typical = tracectx.new_trace()
+        assert tracectx.tail_keep(typical, 5.0) is False
+
+
+def test_head_sampling_one_in_n(clean_trace):
+    with config.knob_env(CYLON_TPU_TRACE_SAMPLE_N="4"):
+        flags = [tracectx.new_trace().sampled for _ in range(8)]
+    assert flags == [True, False, False, False, True, False, False, False]
+    with config.knob_env(CYLON_TPU_TRACE_SAMPLE_N="0"):
+        assert tracectx.new_trace().sampled is False
+
+
+def test_sampled_slow_buffer_survives_fast_flood(clean_trace):
+    """The satellite's overflow scenario: under tail sampling a flood of
+    fast requests discards ITS OWN events at close, so the buffer never
+    starves out the one sampled/slow request worth keeping — and the
+    overflow drop counter stays monotone (retention discards are never
+    un-counted as drops)."""
+    with config.knob_env(CYLON_TPU_TRACE="1",
+                         CYLON_TPU_TRACE_BUFFER_CAP="32",
+                         CYLON_TPU_TRACE_TAIL_MS="1000"):
+        keeper = tracectx.new_trace(sampled=True)
+        with tracectx.activate(keeper):
+            for i in range(8):
+                obs_spans.instant(f"keep{i}")
+        assert tracectx.finish_request(keeper, 0.1) is True  # sampled
+        drops_seen = obs_spans.dropped()
+        for n in range(10):
+            fast = tracectx.new_trace(sampled=False)
+            with tracectx.activate(fast):
+                for i in range(4):
+                    obs_spans.instant(f"fast{n}.{i}")
+            assert tracectx.finish_request(fast, 0.1) is False
+            assert obs_spans.dropped() >= drops_seen  # monotone
+            drops_seen = obs_spans.dropped()
+        # 8 + 40 events through a 32-cap buffer: without retention the
+        # keeper would have been starved; with it, every keeper event
+        # survives and NOTHING overflowed (each fast request freed its
+        # own events at close)
+        names = [e.name for e in obs_spans.events()]
+        assert names == [f"keep{i}" for i in range(8)]
+        assert obs_spans.dropped() == 0
+        # now a real overflow: an OPEN trace past the cap drops (counted)
+        big = tracectx.new_trace()
+        with tracectx.activate(big):
+            for i in range(40):
+                obs_spans.instant(f"big{i}")
+        overflow = obs_spans.dropped()
+        assert overflow > 0
+        # closing it discards its BUFFERED events but never un-counts
+        # the overflow drops
+        tracectx.finish_request(big, 0.1)
+        assert obs_spans.dropped() == overflow
+        assert [e.name for e in obs_spans.events()] == \
+            [f"keep{i}" for i in range(8)]
+    assert _counter("trace.tail_dropped") == 11
+    assert _counter("trace.tail_kept") == 1
+    assert _counter("trace.tail_events_discarded") > 0
+
+
+# ---------------------------------------------------------------------------
+# propagation matrix: serve → plan/exec → shuffle (one process)
+# ---------------------------------------------------------------------------
+
+def test_serve_request_propagates_through_engine(clean_trace, tmp_path,
+                                                 ctx4):
+    from cylon_tpu.table import Table
+
+    left, right = _inputs(3)
+    with config.knob_env(CYLON_TPU_TRACE="1",
+                         CYLON_TPU_TRACE_DIR=str(tmp_path / "tr"),
+                         CYLON_TPU_DURABLE_DIR=str(tmp_path / "j")):
+        svc = QueryService(ctx=ctx4)
+        try:
+            t = svc.submit("t0", "join", left, right, on="k", passes=2,
+                           mode="hash")
+            t.result(timeout=WAIT_S)
+            raw = {"k": (left["k"] % 7).astype(np.int64), "v": left["a"]}
+            tbl = Table.from_numpy(list(raw), list(raw.values()), ctx=ctx4)
+            q = tbl.plan().groupby(["k"], {"v": "sum"})
+            tp = svc.submit("t0", "plan", q)
+            tp.result(timeout=WAIT_S)
+        finally:
+            svc.close()
+        assert t.trace_id is not None and tp.trace_id is not None
+        assert t.trace_id != tp.trace_id
+        evs = obs_spans.events()
+
+        def names_of(trace_id):
+            return {e.name for e in evs
+                    if e.trace is not None and e.trace[0] == trace_id}
+
+        # serve→exec→shuffle: the join's engine work — pass loop on a
+        # single-controller world, table-level join kernels on the
+        # distributed one — and its collectives, all under ONE trace id
+        traced = names_of(t.trace_id)
+        assert "serve.request" in traced
+        assert "exec.pass" in traced or "join.gather" in traced
+        assert any(n.startswith("shuffle.") for n in traced)
+        # serve→plan: the planned query's optimizer/executor spans join
+        # ITS OWN request trace, not the join's
+        planned = names_of(tp.trace_id)
+        assert "serve.request" in planned
+        assert "plan.execute" in planned
+        # every traced event's parent resolves inside the same trace
+        # (the root's parent is the minted context, which records no
+        # event itself)
+        ids = {e.trace[1] for e in evs
+               if e.trace is not None and e.trace[0] == t.trace_id}
+        root = next(e for e in evs if e.name == "serve.request"
+                    and e.trace[0] == t.trace_id)
+        for e in evs:
+            if e.trace is None or e.trace[0] != t.trace_id or e is root:
+                continue
+            assert e.trace[2] in ids | {root.trace[2]}, e.name
+        # the exported trace supports the critical-path walk end to end
+        path = obs_export.export_trace()
+        cp = _cp_mod().critical_path(
+            obs_export.load_trace(path)["traceEvents"], t.trace_id)
+        assert cp is not None
+        assert cp["trace_id"] == t.trace_id
+        assert cp["root"]["name"] == "serve.request"
+        assert cp["coverage"] is not None and cp["coverage"] >= 0.5
+
+
+def test_client_supplied_traceparent_adopted(clean_trace):
+    left, right = _inputs(4)
+    parent = tracectx.new_trace(sampled=True)
+    svc = QueryService()
+    try:
+        t = svc.submit("t0", "join", left, right, on="k", passes=1,
+                       mode="hash", traceparent=parent.traceparent())
+        t.result(timeout=WAIT_S)
+        assert t.trace.trace_id == parent.trace_id
+        assert t.trace.parent_span_id == parent.span_id
+        assert t.trace.sampled is True
+        # malformed header: fresh trace, never a failed submit
+        t2 = svc.submit("t0", "join", left, right, on="k", passes=1,
+                        mode="hash", traceparent="not-a-traceparent")
+        t2.result(timeout=WAIT_S)
+        assert t2.trace_id is not None
+        assert t2.trace.trace_id != parent.trace_id
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# propagation matrix: cancelled + shed requests close their trace
+# ---------------------------------------------------------------------------
+
+def test_cancelled_and_shed_requests_close_their_trace(clean_trace,
+                                                       monkeypatch):
+    started, release = threading.Event(), threading.Event()
+    orig = service_mod._RUNNERS["join"]
+
+    def runner(*args, **kwargs):
+        started.set()
+        assert release.wait(WAIT_S), "blocked runner never released"
+        return orig(*args, **kwargs)
+
+    monkeypatch.setitem(service_mod._RUNNERS, "join", runner)
+    left, right = _inputs(5)
+    with config.knob_env(CYLON_TPU_TRACE="1",
+                         CYLON_TPU_TRACE_TAIL_MS="100000"):
+        svc = QueryService(queue_cap=1)
+        try:
+            t0 = svc.submit("a", "join", left, right, on="k", passes=1,
+                            mode="hash")
+            assert started.wait(WAIT_S)
+            t1 = svc.submit("a", "join", left, right, on="k", passes=1,
+                            mode="hash")
+            with pytest.raises(CylonError) as exc:
+                svc.submit("a", "join", left, right, on="k", passes=1,
+                           mode="hash")
+            assert exc.value.code in (Code.ResourceExhausted,
+                                      Code.Unavailable)
+            # the shed request closed its trace at admission (failed ⇒
+            # kept under retention) and its terminal instant carries it
+            assert _counter("trace.tail_kept") == 1
+            shed_evs = [e for e in obs_spans.events()
+                        if e.name == "serve.shed"]
+            assert shed_evs and shed_evs[-1].trace is not None
+            t1.cancel()
+            release.set()
+            t0.result(timeout=WAIT_S)
+            assert t1.state == service_mod.CANCELLED
+            assert t1.trace_id is not None
+        finally:
+            release.set()
+            svc.close()
+    # every request closed its trace exactly once: shed + cancelled are
+    # "failed" for retention (kept), the completed one raced the 100s
+    # threshold (kept or dropped, still counted)
+    assert (_counter("trace.tail_kept")
+            + _counter("trace.tail_dropped")) == 3
+
+
+# ---------------------------------------------------------------------------
+# propagation matrix: serve → elastic barrier (the coordinator wire)
+# ---------------------------------------------------------------------------
+
+def test_barrier_propagates_trace_across_ranks(clean_trace):
+    """Rank 0 arrives at a rendezvous carrying a request context; the
+    coordinator latches it, stamps its rendezvous bookkeeping with it,
+    and echoes it to rank 1 — which arrived with NO context and adopts
+    the requester's trace."""
+    c = elastic.Coordinator(2, heartbeat_timeout_s=HB_TIMEOUT).start()
+    addr = f"{c.address[0]}:{c.address[1]}"
+    agents = [elastic.Agent(addr, r, **HB).start() for r in range(2)]
+    ctx = tracectx.new_trace()
+    try:
+        for a in agents:
+            a.wait_formed()
+        epoch = agents[0].view().epoch
+        with config.knob_env(CYLON_TPU_TRACE="1"):
+            results = {}
+
+            def other():  # rank 1: no context of its own
+                results[1] = agents[1].barrier("b1", epoch)
+
+            th = threading.Thread(target=other, daemon=True)
+            th.start()
+            with tracectx.activate(ctx):
+                agents[0].barrier("b1", epoch)
+            th.join(WAIT_S)
+            assert 1 in results, "rank 1 never left the barrier"
+        # rank 1 adopted the requester's trace over the wire
+        adopted = agents[1].barrier_trace
+        assert adopted is not None
+        assert adopted.trace_id == ctx.trace_id
+        # the coordinator's rendezvous bookkeeping joined the trace too
+        skew = [e for e in obs_spans.events()
+                if e.name == "collective.skew"]
+        assert skew and skew[-1].attrs.get("trace_id") == ctx.trace_id
+        st = control.request(c.address, {"cmd": "status"})
+        assert st["collectives"][-1].get("trace_id") == ctx.trace_id
+        # the latch is per-rendezvous: a later UNTRACED rendezvous must
+        # not adopt the finished request's trace (stale adoption would
+        # stamp an unrelated run's spans with a closed request's id)
+        results.clear()
+        th2 = threading.Thread(
+            target=lambda: results.setdefault(
+                1, agents[1].barrier("b2", epoch)), daemon=True)
+        th2.start()
+        agents[0].barrier("b2", epoch)
+        th2.join(WAIT_S)
+        assert 1 in results, "rank 1 never left barrier b2"
+        assert agents[1].barrier_trace is None
+    finally:
+        for a in agents:
+            a.stop()
+        c.stop()
+
+
+def test_control_verb_carries_traceparent(clean_trace):
+    seen = []
+
+    def handler(req):
+        cur = tracectx.current()
+        seen.append((req.get("traceparent"), cur))
+        return {"ok": True}
+
+    srv = control.JsonServer(handler).start()
+    try:
+        ctx = tracectx.new_trace()
+        with tracectx.activate(ctx):
+            control.request(srv.address, {"cmd": "ping"})
+        control.request(srv.address, {"cmd": "ping"})  # no context
+    finally:
+        srv.close()
+    tp, handler_ctx = seen[0]
+    # the verb carried the wire form, and the handler ran under a CHILD
+    # of the caller's context (same trace, caller's span as parent)
+    assert tracectx.parse_traceparent(tp).trace_id == ctx.trace_id
+    assert handler_ctx is not None
+    assert handler_ctx.trace_id == ctx.trace_id
+    assert handler_ctx.parent_span_id == ctx.span_id
+    assert seen[1] == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# terminal instants + flight dumps carry the trace
+# ---------------------------------------------------------------------------
+
+def test_deadline_fired_instant_carries_arming_trace(clean_trace):
+    ctx = tracectx.new_trace()
+    with config.knob_env(CYLON_TPU_TRACE="1"):
+        # constructed OUTSIDE the request context (exactly how serve
+        # builds it, before activating the ticket's trace) but ARMED
+        # inside it: the capture happens at __enter__, and the watchdog
+        # — which fires on its own timer thread with fresh contextvar
+        # state — still joins the request whose budget it killed
+        dl = durable.PassDeadline(0.01, site="unit")
+        with tracectx.activate(ctx):
+            with dl:
+                assert dl.fired.wait(5.0), "deadline never fired"
+                time.sleep(0.02)  # let _fire finish recording
+    fired = [e for e in obs_spans.events() if e.name == "deadline.fired"]
+    assert fired and fired[-1].trace is not None
+    assert fired[-1].trace[0] == ctx.trace_id
+
+
+def test_flight_dump_carries_active_trace(clean_trace, tmp_path):
+    ctx = tracectx.new_trace()
+    with config.knob_env(CYLON_TPU_TRACE_DIR=str(tmp_path)):
+        obs_fleet.set_run_id("trace_dump_test")
+        try:
+            with tracectx.activate(ctx):
+                path = obs_fleet.flight_record("unit_test", probe=1)
+            # repeated terminal events REWRITE the same per-(run, rank)
+            # file: read the traced dump before the untraced one lands
+            doc = obs_fleet.load_flight(path)
+            untraced = obs_fleet.flight_record("unit_test2", probe=2)
+        finally:
+            obs_fleet.set_run_id(None)
+    assert doc["trace_id"] == ctx.trace_id
+    assert obs_fleet.load_flight(untraced)["trace_id"] is None
+
+
+# ---------------------------------------------------------------------------
+# openmetrics: build_info + always-present retention counters
+# ---------------------------------------------------------------------------
+
+def test_openmetrics_build_info_and_retention_counters(clean_trace):
+    text = openmetrics.render()
+    parsed = openmetrics.parse(text)
+    info = parsed["cylon_tpu_build_info"]
+    assert info["type"] == "gauge"
+    (_name, labels, value), = info["samples"]
+    assert value == 1.0
+    assert set(labels) >= {"version", "rank", "incarnation"}
+    # the retention pair exists zero-valued before any request closes —
+    # a dashboard can tell "no requests yet" from "broken deploy"
+    assert "cylon_tpu_trace_tail_kept_total 0" in text
+    assert "cylon_tpu_trace_tail_dropped_total 0" in text
+    with config.knob_env(CYLON_TPU_TRACE_TAIL_MS="50"):
+        tracectx.finish_request(tracectx.new_trace(), 80.0)
+    text2 = openmetrics.render()
+    assert "cylon_tpu_trace_tail_kept_total 1" in text2
+    openmetrics.parse(text2)  # still schema-valid
+    # the fleet aggregate carries the same always-on surface: identity
+    # gauge once, the retention pair zero-valued PER RANK
+    fleet = openmetrics.render_fleet({0: {}, 1: {"counters": {}}})
+    openmetrics.parse(fleet)
+    assert "cylon_tpu_build_info" in fleet
+    for r in (0, 1):
+        assert (f'cylon_tpu_trace_tail_kept_total{{rank="{r}"}} 0'
+                in fleet), fleet
+
+
+# ---------------------------------------------------------------------------
+# critical-path walk (synthetic trace: exact, deterministic)
+# ---------------------------------------------------------------------------
+
+def _cp_mod():
+    import importlib.util
+    import os as _os
+
+    p = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "tools", "critical_path.py")
+    spec = importlib.util.spec_from_file_location("_cp_unit", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ev(name, pid, tid, ts, dur, trace, span, parent, **attrs):
+    return {"name": name, "ph": "X", "pid": pid, "tid": tid,
+            "ts": ts, "dur": dur,
+            "args": {"trace_id": trace, "span_id": span,
+                     "parent_span_id": parent, **attrs}}
+
+
+T = "ab" * 16
+
+
+def test_critical_path_redirects_wait_through_remote_work():
+    """A rank stalled in a rendezvous is waiting FOR the slowest
+    participant: the walk must name the remote rank's work, not the
+    local wait for it — and the segments must tile the wall."""
+    events = [
+        _ev("serve.request", 0, 1, 0.0, 100.0, T, "r0", None),
+        _ev("exec.pass", 0, 1, 0.0, 40.0, T, "s1", "r0"),
+        _ev("elastic.barrier", 0, 1, 40.0, 55.0, T, "s2", "r0"),
+        _ev("elastic.pass_guard", 1, 9, 42.0, 50.0, T, "s3", "r0"),
+        _ev("exec.pass", 0, 1, 95.0, 5.0, T, "s4", "r0"),
+    ]
+    cp = _cp_mod().critical_path(events)
+    assert cp["trace_id"] == T
+    assert cp["root"]["name"] == "serve.request"
+    assert cp["total_us"] == 100.0
+    assert cp["coverage"] == 1.0  # tiles end to end
+    # the seeded-straggler shape: remote work dominates, never the wait
+    assert cp["dominant"]["name"] == "elastic.pass_guard"
+    assert cp["dominant"]["rank"] == 1
+    assert cp["decomposition"]["wait_us"] == pytest.approx(5.0)
+    assert cp["decomposition"]["compute_us"] == pytest.approx(95.0)
+    assert cp["by_rank"]["1"]["compute_us"] == pytest.approx(50.0)
+    names = [s["name"] for s in cp["segments"]]
+    assert names == ["exec.pass", "elastic.barrier", "elastic.pass_guard",
+                     "elastic.barrier", "exec.pass"]
+
+
+def test_critical_path_uncovered_wait_stays_wait():
+    events = [
+        _ev("serve.request", 0, 1, 0.0, 100.0, T, "r0", None),
+        _ev("exec.pass", 0, 1, 0.0, 40.0, T, "s1", "r0"),
+        _ev("elastic.barrier", 0, 1, 40.0, 55.0, T, "s2", "r0"),
+        _ev("exec.pass", 0, 1, 95.0, 5.0, T, "s4", "r0"),
+    ]
+    cp = _cp_mod().critical_path(events)
+    assert cp["coverage"] == 1.0
+    assert cp["dominant"]["name"] == "elastic.barrier"
+    assert cp["wait_fraction"] == pytest.approx(0.55)
+
+
+def test_critical_path_self_time_not_wrapper(clean_trace):
+    # a fat wrapper never swallows the leaf that actually ran: the leaf
+    # owns its interval, the wrapper only its uncovered tails
+    events = [
+        _ev("serve.request", 0, 1, 0.0, 100.0, T, "r0", None),
+        _ev("wrapper", 0, 1, 0.0, 100.0, T, "s1", "r0"),
+        _ev("shuffle.exchange", 0, 1, 10.0, 80.0, T, "s2", "s1"),
+    ]
+    cp = _cp_mod().critical_path(events)
+    assert cp["coverage"] == 1.0
+    assert cp["dominant"]["name"] == "shuffle.exchange"
+    assert cp["dominant"]["class"] == "transfer"
+    assert cp["decomposition"]["transfer_us"] == pytest.approx(80.0)
+    assert cp["decomposition"]["compute_us"] == pytest.approx(20.0)
+
+
+def test_critical_path_none_without_traced_request():
+    assert _cp_mod().critical_path([
+        {"name": "x", "ph": "X", "pid": 0, "tid": 1, "ts": 0.0,
+         "dur": 5.0, "args": {}}]) is None
+    assert _cp_mod().critical_path([]) is None
+
+
+def test_critical_path_selects_requested_trace():
+    T2 = "cd" * 16
+    events = [
+        _ev("serve.request", 0, 1, 0.0, 10.0, T, "r0", None),
+        _ev("serve.request", 0, 2, 0.0, 50.0, T2, "q0", None),
+    ]
+    cp = _cp_mod().critical_path(events, T)
+    assert cp["trace_id"] == T and cp["total_us"] == 10.0
+    # default: longest serve.request root wins
+    assert _cp_mod().critical_path(events)["trace_id"] == T2

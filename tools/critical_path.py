@@ -1,0 +1,320 @@
+"""Critical-path decomposition of one causally-traced request.
+
+A merged trace (tools/trace_merge.py) lays every rank's spans on one
+aligned clock, and PR 13's causal context stamps each span with its
+(trace_id, span_id, parent_span_id) identity — but a pile of concurrent
+spans still doesn't answer "why was THIS request slow".  This tool
+walks one request's spans BACKWARDS from its completion, repeatedly
+asking "what was the last thing to finish before this point?" — the
+slowest-participant attribution of arXiv 1810.11112 lifted from a
+single collective to a whole request:
+
+- the walk runs over SELF-TIME intervals (a span minus its same-track
+  children, the flame-graph decomposition), so a fat wrapper never
+  swallows the leaf that actually ran;
+- WAIT-class spans (``elastic.barrier``) are never allowed to dominate
+  the path while real work overlapped them on any rank: a rank stalled
+  in a rendezvous is *waiting for* the slowest participant, so the walk
+  jumps to the latest-finishing work — the seeded-delay rank's pass, not
+  the fast rank's wait for it.  Only a gap no work covers is attributed
+  to the wait span (or reported untracked);
+- every segment is classified wait / transfer / compute by span name,
+  yielding the per-rank wait-vs-compute-vs-transfer decomposition the
+  ROADMAP's overlap work will be judged by.
+
+The resulting segments tile the request wall end to end, so coverage is
+a self-check of the walk (and of the trace: heavy drops shrink it), not
+a tautology — a trace whose spans don't causally connect will show it.
+
+Pure stdlib + JSON (no jax, no package import), shared by
+``tools/trace_report.py --critical-path`` and ``tools/trace_merge.py``.
+
+Usage:
+    python tools/critical_path.py MERGED.json [--trace-id ID] [--json]
+                                  [--top K]
+
+Exit codes: 0 ok; 2 no traced request found in the input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+#: span-name classification for the wait/transfer/compute decomposition.
+#: WAIT spans measure time blocked on someone else's progress (they are
+#: redirected through, never kept on the path while work overlaps);
+#: TRANSFER spans move bytes (pack/unpack/collective/spill); everything
+#: else is compute.
+WAIT_PREFIXES = ("elastic.barrier",)
+TRANSFER_PREFIXES = ("shuffle.", "durable.spill", "durable.load", "io.")
+
+#: ignore sub-microsecond residue when sweeping the cursor backwards
+EPS_US = 1e-3
+
+
+def classify(name: str) -> str:
+    for p in WAIT_PREFIXES:
+        if name.startswith(p):
+            return "wait"
+    for p in TRANSFER_PREFIXES:
+        if name.startswith(p):
+            return "transfer"
+    return "compute"
+
+
+def traced_spans(events: List[dict],
+                 trace_id: Optional[str] = None) -> List[dict]:
+    """The "X" events carrying a causal identity (args.trace_id),
+    optionally restricted to one trace."""
+    out = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        a = e.get("args") or {}
+        if not a.get("trace_id") or not a.get("span_id"):
+            continue
+        if trace_id is not None and a["trace_id"] != trace_id:
+            continue
+        out.append(e)
+    return out
+
+
+def find_root(events: List[dict],
+              trace_id: Optional[str] = None) -> Optional[dict]:
+    """The request's root span: a traced span whose parent_span_id names
+    no event in the same trace (the minted context itself records no
+    event).  ``serve.request`` wins outright; ties break to the longest
+    wall — the request, not some stray annotated helper."""
+    spans = traced_spans(events, trace_id)
+    if not spans:
+        return None
+    ids_by_trace: Dict[str, set] = defaultdict(set)
+    for e in spans:
+        ids_by_trace[e["args"]["trace_id"]].add(e["args"]["span_id"])
+    roots = [e for e in spans
+             if e["args"].get("parent_span_id")
+             not in ids_by_trace[e["args"]["trace_id"]]]
+    if not roots:
+        return None
+    served = [e for e in roots if e["name"] == "serve.request"]
+    pool = served or roots
+    return max(pool, key=lambda e: e.get("dur", 0.0))
+
+
+def self_intervals(spans: List[dict]) -> List[dict]:
+    """Flame-graph self-time pieces: per (pid, tid) track, each span's
+    interval minus its same-track children, as
+    ``{"ev", "t0", "t1", "cls"}`` rows.  Cross-rank children live on
+    other tracks and are deliberately NOT subtracted — the walk itself
+    decides whether remote work explains a local wait."""
+    by_track: Dict[Tuple, List[dict]] = defaultdict(list)
+    for e in spans:
+        by_track[(e.get("pid"), e.get("tid"))].append(e)
+    out: List[dict] = []
+
+    def emit(ev: dict, t0: float, t1: float) -> None:
+        if t1 - t0 > EPS_US:
+            out.append({"ev": ev, "t0": t0, "t1": t1,
+                        "cls": classify(ev["name"])})
+
+    for track in by_track.values():
+        track.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        # stack of [event, cursor]: cursor = start of the not-yet-emitted
+        # tail of the span's self time
+        stack: List[list] = []
+        for e in track:
+            ts, end = e["ts"], e["ts"] + e.get("dur", 0.0)
+            while stack and ts >= stack[-1][0]["ts"] + \
+                    stack[-1][0].get("dur", 0.0):
+                top = stack.pop()
+                emit(top[0], top[1], top[0]["ts"] + top[0].get("dur", 0.0))
+            if stack:
+                emit(stack[-1][0], stack[-1][1], ts)
+                stack[-1][1] = end
+            stack.append([e, ts])
+        while stack:
+            top = stack.pop()
+            emit(top[0], top[1], top[0]["ts"] + top[0].get("dur", 0.0))
+    return out
+
+
+def critical_path(events: List[dict], trace_id: Optional[str] = None,
+                  top: int = 3) -> Optional[dict]:
+    """Walk one request's trace backwards from completion; returns the
+    summary dict (None when no traced request exists in ``events``).
+
+    The walk: from the request's end, repeatedly take the LATEST-ending
+    non-wait self-time interval below the cursor (clamped to it) — the
+    last thing to finish is what completion was waiting on — and jump to
+    its start.  A stretch no work covers is attributed to the wait span
+    overlapping it (a rendezvous stall), or reported untracked."""
+    root = find_root(events, trace_id)
+    if root is None:
+        return None
+    tid_ = root["args"]["trace_id"]
+    spans = traced_spans(events, tid_)
+    t_start, t_end = root["ts"], root["ts"] + root.get("dur", 0.0)
+    ivs = self_intervals(spans)
+    work = [iv for iv in ivs if iv["cls"] != "wait"]
+    waits = [iv for iv in ivs if iv["cls"] == "wait"]
+
+    segments: List[dict] = []
+
+    def seg(ev: Optional[dict], cls: str, t0: float, t1: float) -> None:
+        segments.append({
+            "name": ev["name"] if ev is not None else "(untracked)",
+            "rank": ev.get("pid") if ev is not None else root.get("pid"),
+            "tid": ev.get("tid") if ev is not None else None,
+            "class": cls, "t0_us": t0, "t1_us": t1, "dur_us": t1 - t0})
+
+    def attribute_gap(lo: float, hi: float) -> None:
+        """A stretch with no work running anywhere: a wait (rendezvous
+        stall) when a wait span covers it, untracked otherwise."""
+        best, overlap = None, 0.0
+        for iv in waits:
+            o = min(iv["t1"], hi) - max(iv["t0"], lo)
+            if o > overlap:
+                best, overlap = iv, o
+        seg(best["ev"] if best else None, "wait", lo, hi)
+
+    cursor = t_end
+    while cursor - t_start > EPS_US:
+        best, best_e = None, t_start
+        for iv in work:
+            if iv["t0"] >= cursor - EPS_US:
+                continue
+            e = min(iv["t1"], cursor)
+            if e <= iv["t0"]:
+                continue
+            # latest end wins; ties go to the deeper (leafier) interval
+            d = (iv["ev"].get("args") or {}).get("depth", 0)
+            bd = (best["ev"].get("args") or {}).get("depth", 0) \
+                if best is not None else -1
+            if best is None or e > best_e + EPS_US \
+                    or (abs(e - best_e) <= EPS_US and d > bd):
+                best, best_e = iv, e
+        if best is None:
+            attribute_gap(t_start, cursor)
+            break
+        if best_e < cursor - EPS_US:
+            attribute_gap(best_e, cursor)
+        lo = max(best["t0"], t_start)
+        seg(best["ev"], best["cls"], lo, best_e)
+        if lo >= cursor:  # no progress (clock pathology): stop cleanly
+            break
+        cursor = lo
+    segments.reverse()
+
+    path_us = sum(s["dur_us"] for s in segments)
+    total_us = t_end - t_start
+    decomp = {"wait_us": 0.0, "transfer_us": 0.0, "compute_us": 0.0}
+    by_rank: Dict[str, Dict[str, float]] = {}
+    for s in segments:
+        decomp[s["class"] + "_us"] += s["dur_us"]
+        r = by_rank.setdefault(str(s["rank"]),
+                               {k: 0.0 for k in decomp})
+        r[s["class"] + "_us"] += s["dur_us"]
+    ranked = sorted(segments, key=lambda s: -s["dur_us"])
+    dominant = ranked[0] if ranked else None
+    return {
+        "trace_id": tid_,
+        "root": {"name": root["name"], "rank": root.get("pid"),
+                 "args": {k: v for k, v in (root.get("args") or {}).items()
+                          if k in ("tenant", "op", "trace_id")}},
+        "total_us": round(total_us, 3),
+        "path_us": round(path_us, 3),
+        "coverage": round(path_us / total_us, 4) if total_us > 0 else None,
+        "wait_fraction": round(decomp["wait_us"] / total_us, 4)
+        if total_us > 0 else None,
+        "decomposition": {k: round(v, 3) for k, v in decomp.items()},
+        "by_rank": {r: {k: round(v, 3) for k, v in d.items()}
+                    for r, d in sorted(by_rank.items())},
+        "dominant": None if dominant is None else {
+            "name": dominant["name"], "rank": dominant["rank"],
+            "class": dominant["class"],
+            "dur_us": round(dominant["dur_us"], 3)},
+        "top_segments": [
+            {"name": s["name"], "rank": s["rank"], "class": s["class"],
+             "dur_us": round(s["dur_us"], 3)}
+            for s in ranked[:max(0, int(top))]],
+        "segments": [{**s, "t0_us": round(s["t0_us"], 3),
+                      "t1_us": round(s["t1_us"], 3),
+                      "dur_us": round(s["dur_us"], 3)}
+                     for s in segments],
+    }
+
+
+def print_summary(cp: dict, *, limit: int = 20) -> None:
+    root = cp["root"]
+    print(f"critical path: trace={cp['trace_id'][:16]}…  "
+          f"root={root['name']} (rank {root['rank']})  "
+          f"wall={cp['total_us'] / 1e3:.3f}ms  "
+          f"coverage={100 * (cp['coverage'] or 0):.1f}%  "
+          f"wait={100 * (cp['wait_fraction'] or 0):.1f}%")
+    d = cp["decomposition"]
+    print(f"  decomposition: compute {d['compute_us'] / 1e3:.3f}ms  "
+          f"transfer {d['transfer_us'] / 1e3:.3f}ms  "
+          f"wait {d['wait_us'] / 1e3:.3f}ms")
+    if cp["by_rank"]:
+        print(f"  {'rank':>6s} {'compute ms':>11s} {'transfer ms':>12s} "
+              f"{'wait ms':>9s}")
+        for r, row in cp["by_rank"].items():
+            print(f"  {r:>6s} {row['compute_us'] / 1e3:11.3f} "
+                  f"{row['transfer_us'] / 1e3:12.3f} "
+                  f"{row['wait_us'] / 1e3:9.3f}")
+    print(f"\n  path segments (chronological, longest {limit}):")
+    print(f"  {'segment':36s} {'rank':>5s} {'class':>9s} {'ms':>10s}")
+    shown = sorted(cp["segments"], key=lambda s: -s["dur_us"])[:limit]
+    shown.sort(key=lambda s: s["t0_us"])
+    for s in shown:
+        print(f"  {s['name'][:36]:36s} {str(s['rank']):>5s} "
+              f"{s['class']:>9s} {s['dur_us'] / 1e3:10.3f}")
+    if cp["dominant"]:
+        dm = cp["dominant"]
+        print(f"\n  dominant segment: {dm['name']} on rank {dm['rank']} "
+              f"({dm['class']}, {dm['dur_us'] / 1e3:.3f}ms)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="critical_path",
+        description="critical-path + wait/compute/transfer decomposition "
+                    "of one causally-traced request in a (merged) "
+                    "cylon_tpu trace")
+    ap.add_argument("trace", help="trace JSON (obs.export or trace_merge "
+                                  "output)")
+    ap.add_argument("--trace-id", default=None,
+                    help="request to analyze (default: the serve.request "
+                         "root, else the longest rootless traced span)")
+    ap.add_argument("--top", type=int, default=3,
+                    help="top-N path segments in the summary (default 3)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary on stdout")
+    args = ap.parse_args(argv)
+    with open(args.trace, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        print(f"critical_path: {args.trace}: not a Chrome-trace export",
+              file=sys.stderr)
+        return 2
+    cp = critical_path(events, args.trace_id, top=args.top)
+    if cp is None:
+        print(f"critical_path: no causally-traced request in "
+              f"{args.trace} (need spans with args.trace_id — "
+              f"CYLON_TPU_TRACE=1 plus an active request context)",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(cp, sys.stdout, indent=1, sort_keys=True)
+        print()
+        return 0
+    print_summary(cp)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

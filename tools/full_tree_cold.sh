@@ -340,6 +340,98 @@ if [ $rc -ne 0 ]; then
   echo "fleet obs smoke (merge) failed (rc=$rc); fix trace_merge before the full tree" >&2
   exit $rc
 fi
+# causal-tracing smoke (ISSUE-13): ONE serve request on rank 0 drives a
+# 3-process elastic gang with a seeded per-pass delay on rank 2; the
+# request's traceparent must propagate over the coordinator wire so the
+# merged trace carries ONE trace_id across all three ranks, and
+# tools/critical_path.py must attribute >=90% of the request wall and
+# name the delayed rank as the dominant path segment
+CT=$(mktemp -d /tmp/cylon_ctrace_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python - "$CT" <<'PYEOF'
+import json, os, subprocess, sys
+
+sys.path.insert(0, os.getcwd())
+from cylon_tpu import elastic
+
+td = sys.argv[1]
+coord = elastic.Coordinator(3, heartbeat_timeout_s=2.5).start()
+addr = f"{coord.address[0]}:{coord.address[1]}"
+base_env = {k: v for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS",
+                         "CYLON_TPU_FAULT_PLAN", "CYLON_TPU_DURABLE_DIR")}
+base_env.update(CYLON_TPU_DURABLE_DIR=os.path.join(td, "journal"),
+                CYLON_TPU_HEARTBEAT_S="0.1",
+                CYLON_TPU_HEARTBEAT_TIMEOUT_S="2.5",
+                CYLON_TPU_TRACE="1",
+                CYLON_TPU_TRACE_DIR=os.path.join(td, "traces"))
+procs = []
+for r in range(3):
+    env = dict(base_env)
+    if r == 2:
+        # the seeded straggler: 3.5s sleep at every pass boundary —
+        # large enough to dominate any warmed host-side work block
+        env["CYLON_TPU_FAULT_PLAN"] = "elastic.pass.r2@1+=delay"
+        env["CYLON_TPU_FAULT_DELAY_S"] = "3.5"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "tests.trace_worker", str(r), "3", addr,
+         os.path.join(td, f"out_r{r}.npz"),
+         os.path.join(td, f"stats_r{r}.json")], env=env))
+try:
+    for p in procs:
+        p.wait(timeout=360)
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    coord.stop()
+for r, p in enumerate(procs):
+    assert p.returncode == 0, (r, p.returncode)
+st = json.load(open(os.path.join(td, "stats_r0.json")))
+assert st["state"] == "done" and st["trace_id"], st
+print(f"tracing smoke: request {st['trace_id']} served in "
+      f"{st['duration_s']:.1f}s across 3 ranks")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "causal tracing smoke (run) failed (rc=$rc); fix trace propagation before the full tree" >&2
+  rm -rf "$CT"; exit $rc
+fi
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/trace_merge.py "$CT/traces" -o "$CT/merged.json" --json \
+    > "$CT/merge_summary.json" \
+  && python - "$CT" <<'PYEOF'
+import json, sys
+td = sys.argv[1]
+summary = json.load(open(f"{td}/merge_summary.json"))
+assert summary["ranks"] == [0, 1, 2], summary["ranks"]
+assert summary["aligned"] is True, summary
+st = json.load(open(f"{td}/stats_r0.json"))
+cp = summary["critical_path"]
+assert cp is not None, "no critical path in merge summary"
+# ONE request trace: the serve-minted id, rooted at serve.request,
+# carried by spans on EVERY rank of the gang
+assert cp["trace_id"] == st["trace_id"], (cp["trace_id"], st["trace_id"])
+assert cp["root"]["name"] == "serve.request", cp["root"]
+merged = json.load(open(f"{td}/merged.json"))
+pids = sorted({e["pid"] for e in merged["traceEvents"]
+               if (e.get("args") or {}).get("trace_id") == cp["trace_id"]})
+assert pids == [0, 1, 2], f"trace does not span all ranks: {pids}"
+# the walk accounts for >=90% of the request wall, and the seeded-delay
+# rank owns the dominant path segment
+assert cp["coverage"] >= 0.9, cp["coverage"]
+assert cp["dominant"]["rank"] == 2, cp["dominant"]
+print(f"tracing smoke ok: trace {cp['trace_id'][:16]}... spans ranks "
+      f"{pids}, coverage {100 * cp['coverage']:.1f}%, dominant segment "
+      f"{cp['dominant']['name']} on rank {cp['dominant']['rank']} "
+      f"({cp['dominant']['dur_us'] / 1e6:.1f}s)")
+PYEOF
+rc=$?
+rm -rf "$CT"
+if [ $rc -ne 0 ]; then
+  echo "causal tracing smoke (merge/critical-path) failed (rc=$rc); fix critical_path before the full tree" >&2
+  exit $rc
+fi
 # serve smoke (ISSUE-7): flood a 2-tenant query service against a
 # single-slot admission queue — overload must resolve as classified
 # sheds + exact serves (never a hang), and a repeated query must hit

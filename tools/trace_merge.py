@@ -268,6 +268,26 @@ def collective_skew(events: List[Dict]) -> List[Dict]:
     return rows
 
 
+_cp_tool_cache = None
+
+
+def _cp_tool():
+    """The sibling critical_path.py, loaded by file path — the one
+    implementation of the critical-path walk shared with trace_report
+    (both tools stay pure stdlib, no package import)."""
+    global _cp_tool_cache
+    if _cp_tool_cache is None:
+        import importlib.util
+
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "critical_path.py")
+        spec = importlib.util.spec_from_file_location("_critical_path", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _cp_tool_cache = mod
+    return _cp_tool_cache
+
+
 def validate_merged(doc: Dict) -> None:
     """Schema + monotonicity: every event well-formed, the non-metadata
     stream sorted ascending on the aligned clock."""
@@ -323,6 +343,10 @@ def main(argv=None) -> int:
     for w in warnings:
         print(f"trace_merge: WARNING: {w}", file=sys.stderr)
     skew = collective_skew(merged["traceEvents"])
+    # causal critical path (PR 13): when the merged timeline carries a
+    # traced request, decompose its wall into path segments — the merge
+    # is exactly the artifact the cross-rank walk needs
+    cp = _cp_tool().critical_path(merged["traceEvents"])
     if args.json:
         json.dump({"out": out,
                    "ranks": merged["otherData"]["ranks"],
@@ -331,7 +355,8 @@ def main(argv=None) -> int:
                    "aligned": merged["otherData"]["aligned"],
                    "per_rank": merged["otherData"]["per_rank"],
                    "warnings": warnings,
-                   "collectives": skew}, sys.stdout, indent=1,
+                   "collectives": skew,
+                   "critical_path": cp}, sys.stdout, indent=1,
                   sort_keys=True)
         print()
         return 0
@@ -347,6 +372,9 @@ def main(argv=None) -> int:
             print(f"  {r['collective'][:40]:40s} {str(r['epoch']):>5s} "
                   f"{len(r['ranks']):>7d} {r['skew_us'] / 1e3:9.3f}  "
                   f"r{r['slowest_rank']}")
+    if cp is not None:
+        print()
+        _cp_tool().print_summary(cp)
     return 0
 
 

@@ -108,24 +108,33 @@ def tenant_attribution(events: List[dict]) -> Dict[str, Tuple[int, float]]:
     return out
 
 
-_merge_tool_cache = None
+_sibling_cache: Dict[str, object] = {}
 
 
-def _merge_tool():
-    """The sibling trace_merge.py, loaded by file path — ONE
-    implementation of the skew-attribution math for both tools, without
-    either gaining a package import (both stay pure stdlib)."""
-    global _merge_tool_cache
-    if _merge_tool_cache is None:
+def _sibling_tool(name: str):
+    """A sibling tool module, loaded by file path — ONE implementation
+    of the shared math (skew attribution in trace_merge.py, the
+    critical-path walk in critical_path.py) without any tool gaining a
+    package import (all stay pure stdlib)."""
+    mod = _sibling_cache.get(name)
+    if mod is None:
         import importlib.util
 
         p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "trace_merge.py")
-        spec = importlib.util.spec_from_file_location("_trace_merge", p)
+                         f"{name}.py")
+        spec = importlib.util.spec_from_file_location(f"_{name}", p)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        _merge_tool_cache = mod
-    return _merge_tool_cache
+        _sibling_cache[name] = mod
+    return mod
+
+
+def _merge_tool():
+    return _sibling_tool("trace_merge")
+
+
+def _cp_tool():
+    return _sibling_tool("critical_path")
 
 
 def collective_skew(events: List[dict]) -> List[dict]:
@@ -199,7 +208,9 @@ def print_plan_profile(doc: dict) -> None:
 
 
 def report_dict(trace_path: str, metrics_path: Optional[str],
-                top: int, plan_path: Optional[str] = None) -> dict:
+                top: int, plan_path: Optional[str] = None,
+                critical_path: bool = False,
+                trace_id: Optional[str] = None) -> dict:
     """The whole report as one machine-readable object (``--json``)."""
     doc = load_trace(trace_path)
     events = doc["traceEvents"]
@@ -211,8 +222,11 @@ def report_dict(trace_path: str, metrics_path: Optional[str],
             instants[e["name"]] += 1
     metrics_path = _sibling_metrics(trace_path, metrics_path)
     m = load_metrics(metrics_path) if metrics_path else {}
+    cp = _cp_tool().critical_path(events, trace_id) \
+        if critical_path else None
     return {
         **({"plan": load_plan_profile(plan_path)} if plan_path else {}),
+        **({"critical_path": cp} if critical_path else {}),
         "trace": trace_path,
         "rank": other.get("rank"),
         "run_id": other.get("run_id"),
@@ -264,8 +278,9 @@ def _sibling_metrics(trace_path: str,
 
 
 def print_report(trace_path: str, metrics_path: "str | None",
-                 top: int) -> None:
-    doc = load_trace(trace_path)
+                 top: int, doc: "Dict[str, object] | None" = None) -> None:
+    if doc is None:
+        doc = load_trace(trace_path)
     events = doc["traceEvents"]
     other = doc.get("otherData", {})
     st = self_times(events)
@@ -408,16 +423,38 @@ def main(argv=None) -> int:
                          "(plan_profile.rN.json from a profiled run / "
                          "EXPLAIN ANALYZE): per-node estimate->actual "
                          "rows, self time, exchange bytes, shard skew")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="also walk the causal critical path of the "
+                         "traced request (tools/critical_path.py): path "
+                         "segments + wait/compute/transfer decomposition")
+    ap.add_argument("--trace-id", default=None,
+                    help="request trace to analyze with --critical-path "
+                         "(default: the serve.request root)")
     args = ap.parse_args(argv)
     if args.json:
-        rep = report_dict(args.trace, args.metrics, args.top, args.plan)
+        rep = report_dict(args.trace, args.metrics, args.top, args.plan,
+                          critical_path=args.critical_path,
+                          trace_id=args.trace_id)
         _dropped_warning(args.trace, rep["dropped_events"])
         json.dump(rep, sys.stdout, indent=1, sort_keys=True)
         print()
         return 0
-    print_report(args.trace, args.metrics, args.top)
+    # one load serves both the report and the critical-path walk — a
+    # merged multi-rank trace is easily hundreds of MB of JSON
+    doc = load_trace(args.trace)
+    print_report(args.trace, args.metrics, args.top, doc=doc)
     if args.plan:
         print_plan_profile(load_plan_profile(args.plan))
+    if args.critical_path:
+        cpt = _cp_tool()
+        cp = cpt.critical_path(doc["traceEvents"], args.trace_id)
+        if cp is None:
+            print("\nno causally-traced request in this trace "
+                  "(need CYLON_TPU_TRACE=1 plus an active request "
+                  "context)", file=sys.stderr)
+            return 2
+        print()
+        cpt.print_summary(cp)
     return 0
 
 
