@@ -42,6 +42,8 @@ RULES: Dict[str, str] = {
     "CY108": "plan optimizer/executor reads a trace-scope knob the plan "
              "fingerprint does not cover",
     "CY109": "realized-data jit layout missing from a plan cache key",
+    "CY110": "blocking device call reachable from a router "
+             "route/placement/reroute control path",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
 }
@@ -86,9 +88,29 @@ SERVE_CONTROL_ROOTS = frozenset({"submit", "cancel", "drain"})
 SERVE_CONTROL_PREFIXES = ("_dispatch", "_admit", "_shed", "_cancel")
 
 #: call names (final identifier) that block the calling thread on device
-#: work, for CY107 reachability
+#: work, for CY107/CY110 reachability
 BLOCKING_DEVICE_NAMES = frozenset({
     "block_until_ready", "device_get", "device_put", "to_numpy"})
+
+#: modules the CY107/CY110 walk treats as host-only leaves: pyarrow's
+#: ``Array.to_numpy`` (the IPC decode in io/arrow_io.py, which the
+#: router wire codec rides) shares a final identifier with the device
+#: fetch but never touches a device — name-level matching cannot tell
+#: them apart, so the known-host-only module is a declared barrier
+HOST_ONLY_MODULES = frozenset({"cylon_tpu.io.arrow_io"})
+
+#: the router package and its control-path roots, for CY110 — the CY107
+#: invariant one tier up: route admission, placement, re-route decisions
+#: and the heartbeat/verb handlers feeding the routing table run on
+#: caller/handler threads, and a blocking device call reachable from
+#: any of them lets ONE wedged replica's device stall placement for the
+#: whole fleet.  Roots: the ``route`` verb, ``_place*``/``_reroute*``/
+#: ``_proxy*``/``_route*``/``_shed*`` helpers, and the ``_handle*`` verb
+#: handlers (heartbeats build the placement view).
+ROUTER_MODULE_PREFIX = "cylon_tpu.router"
+ROUTER_CONTROL_ROOTS = frozenset({"route"})
+ROUTER_CONTROL_PREFIXES = ("_place", "_reroute", "_proxy", "_route",
+                           "_shed", "_handle", "_on_replica")
 
 #: the planner package and its rule/executor roots, for CY108: the plan
 #: FINGERPRINT is the durable/serve result-cache key for whole planned
@@ -945,22 +967,7 @@ def _check_serve_blocking(prog: _Program, mod: _Module) -> None:
         if not (name in SERVE_CONTROL_ROOTS
                 or name.startswith(SERVE_CONTROL_PREFIXES)):
             continue
-        seen: Set[str] = set()
-        stack = [f.qual]
-        hit: Set[str] = set()
-        while stack:
-            q = stack.pop()
-            if q in seen:
-                continue
-            seen.add(q)
-            fn = prog.by_qual.get(q)
-            if fn is None:
-                continue
-            hit |= fn.call_finals & BLOCKING_DEVICE_NAMES
-            for c in fn.calls:
-                if c.startswith(("self.", "cls.")):
-                    c = f"{fn.module}.{c.split('.', 1)[1]}"
-                stack.append(c)
+        hit = _blocking_device_reach(prog, f)
         if hit:
             mod.findings.append(Finding(
                 "CY107", mod.path, f.lineno,
@@ -969,6 +976,63 @@ def _check_serve_blocking(prog: _Program, mod: _Module) -> None:
                 f"would stop the service from admitting or shedding",
                 "move the device work into the executor (_run_ticket); "
                 "admission/dispatch decisions must be host-only"))
+
+
+def _blocking_device_reach(prog: _Program, f: _Func) -> Set[str]:
+    """Blocking device calls reachable from ``f`` (the CY107/CY110
+    shared walk): ``self.X``/``cls.X`` calls resolve against
+    same-module functions so class methods participate."""
+    seen: Set[str] = set()
+    stack = [f.qual]
+    hit: Set[str] = set()
+    while stack:
+        q = stack.pop()
+        if q in seen:
+            continue
+        seen.add(q)
+        fn = prog.by_qual.get(q)
+        if fn is None or fn.module in HOST_ONLY_MODULES:
+            continue
+        hit |= fn.call_finals & BLOCKING_DEVICE_NAMES
+        for c in fn.calls:
+            if c.startswith(("self.", "cls.")):
+                c = f"{fn.module}.{c.split('.', 1)[1]}"
+            stack.append(c)
+    return hit
+
+
+def _check_router_blocking(prog: _Program, mod: _Module) -> None:
+    """CY110: a router control-path root (``route`` / ``_place*`` /
+    ``_reroute*`` / ``_proxy*`` / ``_route*`` / ``_shed*`` /
+    ``_handle*`` / ``_on_replica*`` in any module under
+    ``cylon_tpu.router``) from which a blocking device call is
+    reachable — the CY107 root-set mechanism extended one tier up.
+
+    The invariant: placement, admission, re-route decisions and every
+    verb handler (heartbeats feed the routing table) run on router
+    threads that the WHOLE fleet's requests share.  A blocking device
+    call reachable from any of them means one wedged replica's device
+    can stall routing for every tenant on every healthy replica — the
+    exact failure isolation the router tier exists to provide.  Device
+    work belongs on the replicas, behind the proxy verbs."""
+    if not mod.name.startswith(ROUTER_MODULE_PREFIX):
+        return
+    for f in mod.funcs.values():
+        name = f.qual.rsplit(".", 1)[-1]
+        if not (name in ROUTER_CONTROL_ROOTS
+                or name.startswith(ROUTER_CONTROL_PREFIXES)):
+            continue
+        hit = _blocking_device_reach(prog, f)
+        if hit:
+            mod.findings.append(Finding(
+                "CY110", mod.path, f.lineno,
+                f"router control path `{name}` reaches blocking device "
+                f"call(s) {', '.join(sorted(hit))} — one wedged "
+                f"replica's device would stall placement for the whole "
+                f"fleet",
+                "device work belongs on the replicas behind the proxy "
+                "verbs; route/placement/reroute decisions must be "
+                "host-only"))
 
 
 def _check_plan_fingerprint(prog: _Program, mod: _Module) -> None:
@@ -1062,6 +1126,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_realized_layout_keys(prog, mod)
         _check_elastic_guards(prog, mod)
         _check_serve_blocking(prog, mod)
+        _check_router_blocking(prog, mod)
         _check_plan_fingerprint(prog, mod)
         for f in mod.funcs.values():
             if f.qual in traced:

@@ -372,6 +372,41 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "membership changes stall — before CoordinatorLost fires "
             "(classified, Code.Unavailable).  0 reproduces the PR-6 "
             "fail-after-3-missed-ticks behavior exactly."),
+    _K("CYLON_TPU_ROUTER_CACHE_AFFINITY", "bool", True, RUNTIME,
+       accessors=("cylon_tpu.router.service.cache_affinity_enabled",),
+       help="Fleet query router: steer a repeated request fingerprint to "
+            "the replica that last served it, so the plan/journal caches "
+            "it warmed are reused (any replica can still replay the run "
+            "from the shared CYLON_TPU_DURABLE_DIR journal — affinity is "
+            "a latency optimization, never a correctness requirement).  "
+            "Off falls back to pure tenant-affinity + least-load "
+            "placement."),
+    _K("CYLON_TPU_ROUTER_POLL_S", "float", 0.05, RUNTIME,
+       accessors=("cylon_tpu.router.service.poll_interval_s",),
+       help="Router-side cadence for polling a proxied request's state "
+            "on its replica (each poll is one small control verb; the "
+            "first poll is immediate so journal cache hits return in "
+            "one round trip)."),
+    _K("CYLON_TPU_ROUTER_RPC_TIMEOUT_S", "float", 5.0, RUNTIME,
+       accessors=("cylon_tpu.router.service.rpc_timeout_s",),
+       help="Socket timeout for one router->replica proxy verb (submit/"
+            "poll/cancel).  Distinct from the request's own deadline: a "
+            "slow QUERY keeps polling; a slow VERB counts toward the "
+            "replica-death detection that triggers re-routing."),
+    _K("CYLON_TPU_ROUTER_TIMEOUT_S", "float", 600.0, RUNTIME,
+       accessors=("cylon_tpu.router.service.route_timeout_s",),
+       help="Absolute per-request bound at the router when the caller "
+            "supplied neither timeout_s nor deadline_s: past it the "
+            "router cancels the proxied ticket and answers a classified "
+            "Code.Timeout — a routed request can never hang even when "
+            "a replica's device wedges mid-run."),
+    _K("CYLON_TPU_ROUTER_MAX_LINE_BYTES", "int", 64 << 20, RUNTIME,
+       accessors=("cylon_tpu.router.service.router_max_line",),
+       help="Wire cap for one router/replica data-plane message (the "
+            "route verb and the submit/poll proxy carry whole encoded "
+            "tables, unlike the 1 MiB control-plane default).  A single "
+            "request larger than this is rejected with a classified "
+            "SerializationError, never silently truncated."),
     _K("CYLON_TPU_PROFILE", "bool", False, RUNTIME,
        accessors=("cylon_tpu.plan.profile.profiler_enabled",),
        help="Query profiler: collect per-plan-node actuals (rows, self "

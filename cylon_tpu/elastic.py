@@ -445,6 +445,12 @@ class Coordinator:
     not touch shared state as a member).
     """
 
+    #: per-request line bound of the coordinator's JsonServer: control
+    #: verbs are small, so the control default stands — the query router
+    #: subclass (cylon_tpu/router), whose `route` verb carries whole
+    #: encoded tables, overrides this with its wire cap
+    SERVER_MAX_LINE = control.MAX_LINE
+
     def __init__(self, world: int, host: str = "127.0.0.1", port: int = 0,
                  heartbeat_timeout_s: Optional[float] = None,
                  log_dir: Optional[str] = None):
@@ -497,7 +503,8 @@ class Coordinator:
             # history before this incarnation is already folded into it,
             # so the file never accumulates dead lifetimes
             self._log.rewrite([self._snapshot_locked()])
-        self._server = control.JsonServer(self._handle, host=host, port=port)
+        self._server = control.JsonServer(self._handle, host=host, port=port,
+                                          max_line=self.SERVER_MAX_LINE)
         self.address: Tuple[str, int] = self._server.address
         self._detector: Optional[threading.Thread] = None
 
@@ -863,8 +870,23 @@ class Coordinator:
                 snap = self._snapshot_locked()
             if self._log is not None:
                 self._log.rewrite([snap])
-        self._server = control.JsonServer(self._handle, host=host,
-                                          port=port)
+        # re-bind with a bounded retry: agents hammering the closed
+        # port during the outage can transiently OCCUPY it (the
+        # localhost self-connect quirk — a connect to a closed port may
+        # pick source port == destination port and succeed against
+        # itself); such a socket dies within one rpc timeout when the
+        # agent's recv times out, so the address frees itself
+        bind_deadline = time.monotonic() + max(5.0, 2 * self.timeout)
+        while True:
+            try:
+                self._server = control.JsonServer(
+                    self._handle, host=host, port=port,
+                    max_line=self.SERVER_MAX_LINE)
+                break
+            except OSError:
+                if time.monotonic() >= bind_deadline:
+                    raise
+                time.sleep(0.05)
         self._server.start()
         obs_spans.instant("coord.restart", incarnation=inc, epoch=epoch,
                           members=members, down_s=down_s)
@@ -1318,6 +1340,20 @@ class Agent:
         if obs_fleet.current_rank() in (None, self.rank):
             obs_fleet.set_clock(kept)
         return kept
+
+    def beat_now(self) -> bool:
+        """Push one full heartbeat (clock + telemetry + metrics payload)
+        immediately, outside the cadence — the registration fast path a
+        serving replica uses right after :meth:`start` so the router's
+        placement view carries its serve address and capacity BEFORE the
+        first scheduled beat.  Best-effort: False when the coordinator
+        was unreachable or answered stale (the beat loop's ordinary
+        failure accounting takes over from there)."""
+        try:
+            self._absorb(self._rpc(self._heartbeat_payload()))
+            return True
+        except (OSError, ValueError):
+            return False
 
     def attach_telemetry(self, fn: Optional[Callable[[], Dict]]) -> None:
         """Install a callable whose dict result rides every heartbeat

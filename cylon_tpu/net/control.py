@@ -27,6 +27,9 @@ from ..obs import tracectx
 from ..status import Status
 
 MAX_LINE = 1 << 20  # a control message is small; a longer line is a bug
+#: data-plane endpoints (the router's serve proxy ships whole encoded
+#: tables) opt into a larger bound per call site; the CONTROL default
+#: stays tight so a runaway membership verb still fails loud
 
 
 class ProtocolError(ConnectionError):
@@ -51,21 +54,23 @@ def send_json(sock: socket.socket, obj: Dict) -> None:
     sock.sendall(json.dumps(obj, sort_keys=True).encode() + b"\n")
 
 
-def recv_json(sock: socket.socket) -> Dict:
-    """Read one newline-terminated JSON object (bounded by MAX_LINE)."""
+def recv_json(sock: socket.socket, max_line: int = MAX_LINE) -> Dict:
+    """Read one newline-terminated JSON object (bounded by ``max_line``,
+    default the control-plane MAX_LINE)."""
     buf = bytearray()
     while not buf.endswith(b"\n"):
-        chunk = sock.recv(4096)
+        chunk = sock.recv(65536)
         if not chunk:
             raise ConnectionError("control peer closed mid-message")
         buf.extend(chunk)
-        if len(buf) > MAX_LINE:
-            raise ProtocolError("control message exceeds MAX_LINE")
+        if len(buf) > max_line:
+            raise ProtocolError(f"control message exceeds {max_line} bytes")
     return json.loads(buf.decode())
 
 
 def request(address: Tuple[str, int], obj: Dict,
-            timeout: float = 5.0, retries: int = 1) -> Dict:
+            timeout: float = 5.0, retries: int = 1,
+            max_line: int = MAX_LINE) -> Dict:
     """One request/response round trip on a fresh connection, with a
     per-request socket timeout on connect AND each send/recv.
 
@@ -89,7 +94,7 @@ def request(address: Tuple[str, int], obj: Dict,
             with socket.create_connection(address, timeout=timeout) as sock:
                 sock.settimeout(timeout)
                 send_json(sock, obj)
-                return recv_json(sock)
+                return recv_json(sock, max_line)
         except ConnectionError as e:
             transient = (isinstance(e, _TRANSIENT_RESETS)
                          or type(e) is ConnectionError)
@@ -116,8 +121,10 @@ class JsonServer:
     """
 
     def __init__(self, handler: Callable[[Dict], Dict],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_line: int = MAX_LINE):
         self._handler = handler
+        self._max_line = int(max_line)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -145,7 +152,7 @@ class JsonServer:
         with conn:
             try:
                 conn.settimeout(5.0)
-                req = recv_json(conn)
+                req = recv_json(conn, self._max_line)
             except (OSError, ValueError):
                 return  # malformed/garbled request: drop the connection
             try:

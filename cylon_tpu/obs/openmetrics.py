@@ -57,13 +57,30 @@ def metrics_port() -> int:
 # ---------------------------------------------------------------------------
 
 
-def _split_label(key: str) -> Tuple[str, Optional[str]]:
-    """Lift the bracketed tenant out of a registry key:
-    ``serve.run_ms[acme]`` -> ("serve.run_ms", "acme")."""
+#: the label-pair bracket grammar: ``name=value`` pairs, names are
+#: exposition-legal identifiers, values exclude the reserved ``, =``
+#: (writers remap them — router/service.py `_safe_label`)
+_LABEL_PAIRS = re.compile(
+    r"^[a-zA-Z_][a-zA-Z0-9_]*=[^,=]*(?:,[a-zA-Z_][a-zA-Z0-9_]*=[^,=]*)*$")
+
+
+def _split_label(key: str) -> Tuple[str, List[Tuple[str, str]]]:
+    """Lift the bracketed labels out of a registry key.  Two grammars:
+
+    - the PR-7/8 tenant form ``serve.run_ms[acme]`` -> one ``tenant``
+      label (the bracket body is the tenant id, arbitrary bytes);
+    - the PR-14 pair form ``router.requests_routed[tenant=a,replica=1]``
+      -> explicit labels, accepted ONLY for ``router.``-prefixed keys
+      (a serve tenant literally named ``x=y`` must keep rendering as a
+      tenant, not sprout an ``x`` label)."""
     if key.endswith("]") and "[" in key:
         base, _, rest = key.partition("[")
-        return base, rest[:-1]
-    return key, None
+        body = rest[:-1]
+        if base.startswith("router.") and _LABEL_PAIRS.match(body):
+            return base, [tuple(p.split("=", 1))  # type: ignore[misc]
+                          for p in body.split(",")]
+        return base, [("tenant", body)]
+    return key, []
 
 
 def metric_name(key: str, *, counter: bool = False) -> str:
@@ -107,25 +124,25 @@ def _render_into(lines: List[str], snapshot: Dict,
             lines.append(f"# TYPE {name} {kind}")
 
     for key in sorted(snapshot.get("counters") or {}):
-        base, tenant = _split_label(key)
+        base, pairs = _split_label(key)
         name = metric_name(base, counter=True)
         head(name, "counter")
-        lab = list(extra_labels) + ([("tenant", tenant)] if tenant else [])
+        lab = list(extra_labels) + pairs
         lines.append(f"{name}{_labels(lab)} "
                      f"{_num((snapshot['counters'])[key])}")
     for key in sorted(snapshot.get("gauges") or {}):
-        base, tenant = _split_label(key)
+        base, pairs = _split_label(key)
         name = metric_name(base)
         head(name, "gauge")
-        lab = list(extra_labels) + ([("tenant", tenant)] if tenant else [])
+        lab = list(extra_labels) + pairs
         lines.append(f"{name}{_labels(lab)} "
                      f"{_num((snapshot['gauges'])[key])}")
     for key in sorted(snapshot.get("histograms") or {}):
         h = (snapshot["histograms"])[key]
-        base, tenant = _split_label(key)
+        base, pairs = _split_label(key)
         name = metric_name(base)
         head(name, "histogram")
-        lab = list(extra_labels) + ([("tenant", tenant)] if tenant else [])
+        lab = list(extra_labels) + pairs
         le = h.get("le") or {}
         count = int(h.get("count", 0))
         if "+Inf" not in le:

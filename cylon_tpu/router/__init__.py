@@ -1,0 +1,24 @@
+"""cylon_tpu.router — fleet query routing: many meshes behind one
+front door, with a shared fleet-wide result cache.
+
+The PR-6/11 elastic coordinator promoted into a query router
+(:class:`QueryRouter`): N independent mesh groups register as serving
+replicas (`ReplicaServer` wrapping a PR-7 `QueryService`, heartbeat
+telemetry carrying serve address + capacity + live load), the ``route``
+verb places requests by tenant affinity with a live-load tiebreak and
+proxies them with classified fleet-scope shedding (never a hang), the
+shared durable journal serves any replica's fingerprint from any
+replica, and a dead replica's queued work is re-routed while in-flight
+work is abandoned classified — the PR-6 contract, one level up.
+"""
+from .replica import ReplicaServer
+from .service import (QueryRouter, RouteShed, RouterClient,
+                      cache_affinity_enabled, poll_interval_s,
+                      route_timeout_s, router_max_line, rpc_timeout_s)
+from .wire import request_key
+
+__all__ = [
+    "QueryRouter", "RouterClient", "ReplicaServer", "RouteShed",
+    "request_key", "cache_affinity_enabled", "poll_interval_s",
+    "rpc_timeout_s", "route_timeout_s", "router_max_line",
+]

@@ -1,0 +1,756 @@
+"""Fleet query router: many meshes behind one front door.
+
+PR 7 serves concurrent tenants on ONE mesh through ONE scheduler
+thread — throughput is capped at a single mesh group no matter how many
+TPU slices exist.  This module promotes the PR-6/11 coordinator into a
+**query router** fronting N independent mesh groups as serving
+replicas:
+
+- **registration rides the existing control plane** — each replica runs
+  a PR-7 `QueryService` behind a :class:`~cylon_tpu.router.replica.
+  ReplicaServer` and joins the router exactly like an elastic rank:
+  ``hello`` + heartbeats, with the replica's serve address, capacity
+  and live queue-depth/HBM telemetry carried on the PR-8 telemetry
+  payload (`ReplicaServer.telemetry`).  ``Agent.beat_now()`` pushes the
+  first full beat immediately, so a replica is placeable the moment it
+  starts;
+- **the `route` verb admits or sheds, never hangs** — a request is
+  placed by tenant affinity with a live-load tiebreak (least queue
+  depth, HBM-headroom guard) and proxied to the chosen replica's data
+  plane (submit/poll, `cylon_tpu.router.wire` codec).  When every live
+  replica sheds or reports saturation the router answers a classified
+  `Code.ResourceExhausted` / `Code.Unavailable` with ``retry_after_s``
+  — overload at fleet scope is exactly as classified as PR 7 made it
+  at mesh scope.  `CYLON_TPU_ROUTER_TIMEOUT_S` bounds a request whose
+  replica wedges mid-run with a classified `Code.Timeout`;
+- **the shared journal is a fleet-wide result cache** — run
+  fingerprints are world-independent (PR 6 proved W→W−1 consumption),
+  so with one shared ``CYLON_TPU_DURABLE_DIR`` any replica replays any
+  replica's journaled plan: a hot dashboard query compiles once
+  fleet-wide.  ``CYLON_TPU_ROUTER_CACHE_AFFINITY`` additionally steers
+  a repeated request fingerprint (`wire.request_key`, content-only) to
+  the replica whose in-memory caches are warm — a latency optimization,
+  never a correctness requirement;
+- **replica death is handled by machinery that already exists** — the
+  dead mesh is fenced by the PR-6/11 epoch/incarnation ledger (the
+  router IS the coordinator), its queued-not-dispatched requests are
+  re-routed to a survivor (``router.reroutes``; never silently lost),
+  and in-flight work follows the PR-6 abandon-don't-retry contract:
+  the client gets a classified retryable `Code.Unavailable` instead of
+  a re-execution into who-knows-what.  The router itself restarts from
+  `CoordLog` (PR 11) with the routing table rebuilt from the next
+  heartbeat round — affinity pins are soft state by design;
+- **causality flows through the hop** — the route verb runs under the
+  caller's presented traceparent (net/control.py), every proxied
+  submit/poll carries the active context, and the replica's serve
+  request becomes a child span: one request, one causally-linked
+  PR-13 trace across router and replicas.
+
+Everything here is host-side stdlib + numpy (no jax): the jaxpr
+collective-budget goldens are untouched by construction, and cylint
+CY110 machine-checks that no blocking device call is reachable from the
+route/placement/reroute control paths.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import config
+from ..elastic import Coordinator
+from ..net import control
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..status import Code, CylonError
+from . import wire
+
+
+# ---------------------------------------------------------------------------
+# knob accessors (registry rows in config.py::KNOBS)
+# ---------------------------------------------------------------------------
+
+def cache_affinity_enabled() -> bool:
+    """``CYLON_TPU_ROUTER_CACHE_AFFINITY``: steer repeated request
+    fingerprints to the replica that last served them."""
+    return bool(config.knob("CYLON_TPU_ROUTER_CACHE_AFFINITY"))
+
+
+def poll_interval_s() -> float:
+    """``CYLON_TPU_ROUTER_POLL_S``: router->replica poll cadence."""
+    return max(0.005, float(config.knob("CYLON_TPU_ROUTER_POLL_S")))
+
+
+def rpc_timeout_s() -> float:
+    """``CYLON_TPU_ROUTER_RPC_TIMEOUT_S``: one proxy verb's socket
+    timeout."""
+    return max(0.05, float(config.knob("CYLON_TPU_ROUTER_RPC_TIMEOUT_S")))
+
+
+def route_timeout_s() -> float:
+    """``CYLON_TPU_ROUTER_TIMEOUT_S``: the absolute per-request bound
+    when the caller supplied no deadline."""
+    return max(0.1, float(config.knob("CYLON_TPU_ROUTER_TIMEOUT_S")))
+
+
+def router_max_line() -> int:
+    """``CYLON_TPU_ROUTER_MAX_LINE_BYTES``: wire cap for one data-plane
+    message (route verb / submit / poll reply carrying whole tables)."""
+    return max(1 << 16, int(config.knob("CYLON_TPU_ROUTER_MAX_LINE_BYTES")))
+
+
+#: consecutive failed proxy verbs against a replica the membership
+#: ledger still believes alive before the router treats it as dead
+#: anyway (the detector will fence it one heartbeat-timeout later; a
+#: routed request must not wait that long to make progress)
+MAX_PROXY_FAILURES = 3
+
+#: affinity maps are soft state: bounded, oldest pin evicted first
+AFFINITY_CAP = 4096
+
+#: the per-replica counter row, single-sourced: every increment site
+#: and the status fallback share this shape
+_PER_REPLICA_ZERO = {"served": 0, "shed": 0, "rerouted_away": 0}
+
+
+def _safe_label(s: str) -> str:
+    """A tenant/op id as spelled inside a labeled metric key: the
+    bracket-pair grammar (``router.x[tenant=a,replica=1]``) reserves
+    ``[ ] , =`` — remap them so an adversarial tenant id cannot corrupt
+    the exposition (lossy on purpose, labels are reporting)."""
+    return (s.replace("[", "(").replace("]", ")")
+             .replace(",", ";").replace("=", ":"))
+
+
+class RouteShed(CylonError):
+    """A route-scope admission rejection: the whole fleet (not one
+    replica) had no room — same classified contract as a PR-7 shed."""
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class QueryRouter(Coordinator):
+    """The PR-6/11 coordinator, promoted: everything a `Coordinator`
+    does (membership, heartbeats, fencing, durable `CoordLog`, the
+    ``status``/``metrics`` verbs) plus the ``route`` verb placing and
+    proxying query requests over the registered serving replicas.
+
+    One process-level object; replicas connect with ordinary
+    `elastic.Agent`\\ s whose telemetry carries a ``replica`` record
+    (`ReplicaServer.telemetry`).  Ranks without a replica record are
+    plain elastic members — a mixed gang routes only over the serving
+    subset.
+    """
+
+    def __init__(self, world: int, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 log_dir: Optional[str] = None):
+        # instance override BEFORE super().__init__ creates the server:
+        # the route verb and its replies carry whole encoded tables, so
+        # the router's JsonServer needs the data-plane line cap
+        self.SERVER_MAX_LINE = router_max_line()
+        self._router_lock = threading.Lock()
+        self._tenant_affinity: Dict[str, int] = {}
+        self._key_affinity: Dict[str, int] = {}
+        self._inflight: Dict[int, int] = {}    # rank -> router-held count
+        self._route_ewma_s: Optional[float] = None
+        self._route_counts = {"routed": 0, "sheds": 0, "reroutes": 0,
+                              "abandoned": 0}
+        self._per_replica: Dict[int, Dict[str, int]] = {}
+        super().__init__(world, host=host, port=port,
+                         heartbeat_timeout_s=heartbeat_timeout_s,
+                         log_dir=log_dir)
+
+    # -- request handling --------------------------------------------------
+
+    def _handle_inner(self, req: Dict) -> Dict:
+        cmd = req.get("cmd")
+        if cmd == "route":
+            if self.stale:
+                return {"ok": False, "status": "stale_coordinator",
+                        "incarnation": self.incarnation,
+                        "error": "superseded coordinator incarnation"}
+            return self._handle_route(req)
+        resp = super()._handle_inner(req)
+        if cmd == "status" and resp.get("ok"):
+            resp["router"] = self.router_status()
+        return resp
+
+    # -- placement (host-only decisions; cylint CY110) ---------------------
+
+    def _replica_view(self) -> Dict[int, Dict]:
+        """Snapshot the live serving replicas from heartbeat telemetry:
+        rank -> {addr, capacity, reported_depth, headroom}.  One short
+        membership-lock hold; the proxy loops never touch shared state
+        while blocked on a socket."""
+        with self._lock:
+            tel = {r: self._telemetry.get(r) for r in self._last_hb}
+        view: Dict[int, Dict] = {}
+        for rank, t in sorted(tel.items()):
+            if not isinstance(t, dict):
+                continue
+            rep = t.get("replica")
+            if not isinstance(rep, dict) or not rep.get("addr"):
+                continue  # a plain elastic member, not a serving replica
+            try:
+                host, port = str(rep["addr"][0]), int(rep["addr"][1])
+            except (KeyError, IndexError, TypeError, ValueError):
+                continue
+            view[rank] = {
+                "addr": (host, port),
+                "capacity": max(1, int(rep.get("capacity", 1) or 1)),
+                "reported_depth": int(t.get("queue_depth", 0) or 0),
+                "headroom": rep.get("hbm_headroom_bytes"),
+            }
+        obs_metrics.gauge_set("router.replicas_live", len(view))
+        return view
+
+    def _retry_after(self, depth: int) -> float:
+        with self._router_lock:
+            per = self._route_ewma_s
+        return max(0.05, (per if per is not None else 0.25)
+                   * max(1, depth + 1))
+
+    def _shed_route(self, tenant: str, code: Code, reason: str,
+                    retry_after: Optional[float]) -> RouteShed:
+        """Build (don't count) a fleet-scope shed: a rotation candidate
+        may still be accepted elsewhere — only the shed actually
+        RETURNED to the client is accounted (`_handle_route`)."""
+        hint = "" if retry_after is None \
+            else f"; retry after ~{retry_after:.2f}s"
+        return RouteShed(code, f"request shed at the router for tenant "
+                               f"{tenant!r}: {reason}{hint}",
+                         retry_after_s=retry_after)
+
+    def _place(self, tenant: str, key: str, est_bytes: int,
+               exclude: Set[int]) -> Tuple[int, Tuple[str, int]]:
+        """Choose AND reserve one replica, or raise a classified
+        `RouteShed`.  Order: cache affinity (a warm replica, when the
+        knob is on), then the tenant's pin, then least live load —
+        affinity never overrides saturation or the HBM-headroom guard,
+        it only breaks ties among replicas that can actually take the
+        request.
+
+        The live-load tiebreak adds the router-held in-flight count to
+        the (heartbeat-lagged) reported depth, and the chosen replica's
+        count is incremented under the SAME lock hold as the decision —
+        a reservation, so a burst of concurrent routes spreads over the
+        fleet instead of every placement reading the same stale zero
+        and piling onto one replica.  The caller releases it
+        (`_note_inflight(rank, -1)`) at terminal state or submit
+        failure.  The fleet-saturation pre-check uses reported depth
+        only (conservative): the replica's own admission control is the
+        authority, and its shed rotates the router onward."""
+        view = self._replica_view()
+        cands = {r: v for r, v in view.items() if r not in exclude}
+        if not cands:
+            raise self._shed_route(
+                tenant, Code.Unavailable,
+                f"no live serving replicas "
+                f"({len(view)} registered, {len(exclude)} excluded)",
+                self.timeout)
+        fits = {r: v for r, v in cands.items()
+                if not (isinstance(v["headroom"], (int, float))
+                        and est_bytes > 0 and v["headroom"] < est_bytes)}
+        if not fits:
+            raise self._shed_route(
+                tenant, Code.ResourceExhausted,
+                f"no replica reports {est_bytes} bytes of HBM headroom",
+                self._retry_after(min(v["reported_depth"]
+                                      for v in cands.values())))
+        if all(v["reported_depth"] >= v["capacity"]
+               for v in fits.values()):
+            raise self._shed_route(
+                tenant, Code.ResourceExhausted,
+                f"every serving replica is saturated "
+                f"({len(fits)} replicas at capacity)",
+                self._retry_after(
+                    min(v["reported_depth"] for v in fits.values())))
+        with self._router_lock:
+            order = sorted(
+                fits, key=lambda r: (fits[r]["reported_depth"]
+                                     + self._inflight.get(r, 0), r))
+            pin = self._tenant_affinity.get(tenant)
+            warm = self._key_affinity.get(key) \
+                if cache_affinity_enabled() else None
+            for preferred in (pin, warm):  # last to front wins: warm
+                # the saturation gate counts the router's own in-flight
+                # reservations too: a burst sharing a tenant within one
+                # heartbeat period must not all read the same stale
+                # reported-zero and pile onto the pinned replica
+                if preferred in order \
+                        and fits[preferred]["reported_depth"] \
+                        + self._inflight.get(preferred, 0) \
+                        < fits[preferred]["capacity"]:
+                    order.remove(preferred)
+                    order.insert(0, preferred)
+            chosen = order[0]
+            self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
+        return chosen, fits[chosen]["addr"]
+
+    def _pin(self, table: Dict, key, rank: int) -> None:
+        table.pop(key, None)
+        table[key] = rank
+        while len(table) > AFFINITY_CAP:
+            table.pop(next(iter(table)))
+
+    def _replica_dead(self, rank: int) -> bool:
+        with self._lock:
+            return rank in self._dead or rank not in self._last_hb
+
+    # -- the route verb ----------------------------------------------------
+
+    def _handle_route(self, req: Dict) -> Dict:
+        tenant = str(req.get("tenant", "default"))
+        op = str(req.get("op", ""))
+        payload = req.get("payload")
+        t0 = time.monotonic()
+        try:
+            if not op or not isinstance(payload, dict):
+                raise CylonError(
+                    Code.Invalid,
+                    f"malformed route request (op={op!r}, payload is "
+                    f"{type(payload).__name__})")
+            with obs_spans.span("router.route", tenant=tenant, op=op):
+                out = self._route(tenant, op, payload, req, t0)
+        except CylonError as e:
+            if isinstance(e, RouteShed):
+                with self._router_lock:
+                    self._route_counts["sheds"] += 1
+                obs_metrics.counter_add("router.sheds")
+                obs_metrics.counter_add(
+                    f"router.sheds[tenant={_safe_label(tenant)}]")
+                obs_spans.instant("router.shed", tenant=tenant,
+                                  code=e.code.name, reason=e.msg[:200])
+            return {"ok": False, "classified": wire.classified(e),
+                    **self._ie()}
+        dur = time.monotonic() - t0
+        with self._router_lock:
+            self._route_counts["routed"] += 1
+            rank = out["replica"]
+            self._per_locked(rank)["served"] += 1
+            if not out.get("cache_hit"):
+                self._route_ewma_s = dur if self._route_ewma_s is None \
+                    else 0.7 * self._route_ewma_s + 0.3 * dur
+        obs_metrics.counter_add("router.requests_routed")
+        obs_metrics.counter_add(
+            f"router.requests_routed[tenant={_safe_label(tenant)},"
+            f"replica={out['replica']}]")
+        return {"ok": True, **out, **self._ie()}
+
+    def _ie(self) -> Dict:
+        return {"incarnation": self.incarnation, "epoch": self._epoch}
+
+    def _route(self, tenant: str, op: str, payload: Dict, req: Dict,
+               t0: float) -> Dict:
+        """Place + proxy one request to completion (or a classified
+        failure) — never a hang: the caller's ``deadline_s`` (or the
+        ``CYLON_TPU_ROUTER_TIMEOUT_S`` default) bounds the whole
+        journey including re-routes."""
+        caller_deadline = req.get("deadline_s")
+        deadline_s = float(caller_deadline) \
+            if caller_deadline is not None else route_timeout_s()
+        deadline = t0 + max(0.05, deadline_s)
+        key = wire.request_key(op, payload)
+        est = max(0, int(req.get("est_bytes", 0) or 0))
+        submit = {"cmd": "submit", "tenant": tenant, "op": op,
+                  "payload": payload}
+        if caller_deadline is not None:
+            # only an EXPLICIT caller budget overrides the replica's
+            # tenant deadline table; the router's default bound stays a
+            # router-side watchdog, not a per-request budget rewrite
+            submit["deadline_s"] = float(caller_deadline)
+        exclude: Set[int] = set()
+        reroutes = 0
+        last_shed: Optional[CylonError] = None
+        while True:
+            if time.monotonic() >= deadline:
+                raise last_shed or CylonError(
+                    Code.Timeout,
+                    f"route exceeded its {deadline_s:g}s bound before "
+                    f"any replica accepted (tenant {tenant!r})")
+            try:
+                rank, addr = self._place(tenant, key, est, exclude)
+            except RouteShed as e:
+                # replicas excluded for SHEDDING make "nothing is left"
+                # the fleet-saturation case: the last replica-level
+                # classified shed (with its retry hint) explains it
+                # better than the bare placement view
+                raise last_shed or e
+            # a fresh idempotency token per placement attempt: the
+            # replica dedups control.request's transient-reset retry of
+            # an ALREADY-ADMITTED submit (same bytes, same token) back
+            # to the same ticket instead of admitting a duplicate
+            submit["token"] = token = uuid.uuid4().hex
+            try:
+                resp = control.request(addr, submit,
+                                       timeout=rpc_timeout_s(),
+                                       max_line=self.SERVER_MAX_LINE)
+            except OSError:
+                # no reply — but the submit MAY have been admitted (a
+                # reply lost for good, past the token-dedup'd retry).
+                # Reap the possible orphan by token; trying the next
+                # replica then stays placement, not a re-route.
+                self._note_inflight(rank, -1)
+                self._try_cancel(addr, None, token=token)
+                exclude.add(rank)
+                continue
+            if not resp.get("ok"):
+                self._note_inflight(rank, -1)
+                c = resp.get("classified")
+                if c is None and resp.get("error"):
+                    c = {"msg": str(resp["error"])}
+                err = wire.classified_error(c)
+                if err.code in (Code.ResourceExhausted, Code.Unavailable):
+                    # one replica's shed is not the fleet's: rotate to
+                    # the next candidate (_place raises the fleet-wide
+                    # classified shed once every replica is excluded)
+                    with self._router_lock:
+                        self._per_locked(rank)["shed"] += 1
+                    last_shed = self._shed_route(
+                        tenant, err.code,
+                        f"replica {rank} shed: {err.msg}",
+                        err.retry_after_s)
+                    exclude.add(rank)
+                    continue
+                raise err  # deterministic (Invalid etc.): propagate
+            req_id = str(resp.get("req_id"))
+            if reroutes == 0:
+                # pin at ACCEPT, not completion: the very next request
+                # of this tenant (or of this fingerprint) should land
+                # where the queue is forming
+                with self._router_lock:
+                    self._pin(self._tenant_affinity, tenant, rank)
+                    self._pin(self._key_affinity, key, rank)
+            try:
+                done = self._proxy_poll(tenant, rank, addr, req_id,
+                                        deadline)
+            finally:
+                self._note_inflight(rank, -1)
+            if done is not None:
+                with self._router_lock:
+                    self._pin(self._key_affinity, key, rank)
+                return {**done, "replica": rank, "reroutes": reroutes}
+            # the replica died with the request queued-not-dispatched:
+            # re-route it to a survivor — never silently lost
+            reroutes += 1
+            exclude.add(rank)
+            with self._router_lock:
+                self._route_counts["reroutes"] += 1
+                self._per_locked(rank)["rerouted_away"] += 1
+            obs_metrics.counter_add("router.reroutes")
+            obs_metrics.counter_add(f"router.reroutes[replica={rank}]")
+            obs_spans.instant("router.reroute", tenant=tenant, op=op,
+                              dead_replica=rank)
+
+    def _per_locked(self, rank: int) -> Dict[str, int]:
+        """One replica's counter row; call holding ``_router_lock``."""
+        return self._per_replica.setdefault(rank,
+                                            dict(_PER_REPLICA_ZERO))
+
+    def _note_inflight(self, rank: int, delta: int) -> None:
+        with self._router_lock:
+            n = self._inflight.get(rank, 0) + delta
+            if n > 0:
+                self._inflight[rank] = n
+            else:
+                self._inflight.pop(rank, None)
+
+    def _proxy_poll(self, tenant: str, rank: int, addr: Tuple[str, int],
+                    req_id: str, deadline: float) -> Optional[Dict]:
+        """Poll one accepted ticket to a terminal state.  Returns the
+        terminal dict, raises the replica's classified error, or returns
+        None when the replica DIED while the ticket was still queued
+        (the caller re-routes).  A death after the ticket was observed
+        running is the PR-6 abandon-don't-retry contract: classified
+        retryable `Code.Unavailable`, never a silent re-execution.
+
+        Two contracts the wire imposes: (a) the queued-vs-running
+        distinction is observed at POLLING granularity — a replica dying
+        before any poll saw ``running`` re-routes, which is exact for
+        the journaled built-in ops (the survivor consumes the dead
+        replica's journaled passes bit-identically) and the reason
+        ``register_op`` handlers must be idempotent; (b) a terminal
+        reply read here is ACKNOWLEDGED back to the replica — the
+        ticket survives a reply lost on the wire (the retried poll
+        regenerates it) and drops only on the ack."""
+        fails = 0
+        observed_running = False
+        poll = {"cmd": "poll", "req_id": req_id}
+        while True:
+            if self._replica_dead(rank):
+                return self._on_replica_death(tenant, rank, addr, req_id,
+                                              observed_running)
+            if time.monotonic() >= deadline:
+                self._try_cancel(addr, req_id)
+                raise CylonError(
+                    Code.Timeout,
+                    f"routed request exceeded its deadline on replica "
+                    f"{rank} (tenant {tenant!r}); proxied ticket "
+                    f"cancelled at the next pass boundary")
+            try:
+                resp = control.request(addr, poll,
+                                       timeout=rpc_timeout_s(),
+                                       max_line=self.SERVER_MAX_LINE)
+            except control.ProtocolError as e:
+                # DETERMINISTIC, not a death: the reply exceeds the
+                # data-plane line cap — every retry would fail the same
+                # way, and counting it toward MAX_PROXY_FAILURES would
+                # declare a healthy replica dead and re-route into the
+                # same wall.  Same classification the request path
+                # gives oversize, naming the knob; the terminal ticket
+                # is acked away so the replica doesn't hold it forever.
+                self._try_ack(addr, req_id)
+                raise CylonError(
+                    Code.SerializationError,
+                    f"replica {rank}'s reply exceeds the "
+                    f"{self.SERVER_MAX_LINE}-byte "
+                    f"CYLON_TPU_ROUTER_MAX_LINE_BYTES wire cap (tenant "
+                    f"{tenant!r}); raise the knob (router AND replicas) "
+                    f"or ship less data per request") from e
+            except OSError:
+                fails += 1
+                if fails >= MAX_PROXY_FAILURES \
+                        or self._replica_dead(rank):
+                    return self._on_replica_death(
+                        tenant, rank, addr, req_id, observed_running)
+                time.sleep(poll_interval_s())
+                continue
+            fails = 0
+            state = resp.get("state")
+            if not resp.get("ok"):
+                if state == "unknown":
+                    # the replica lost track of an ADMITTED ticket
+                    # (TICKET_CAP eviction, a data-plane restart): the
+                    # replica's failure, not the caller's — classified
+                    # RETRYABLE, never the replica's unknown-req_id
+                    # Code.Invalid (which would read as a caller bug)
+                    raise CylonError(
+                        Code.Unavailable,
+                        f"replica {rank} lost track of an admitted "
+                        f"request (ticket evicted or replica restarted; "
+                        f"tenant {tenant!r}) — resubmit to replay "
+                        f"journaled passes",
+                        retry_after_s=self._retry_after(0))
+                raise wire.classified_error(resp.get("classified"))
+            if state == "done":
+                self._try_ack(addr, req_id)
+                return {"result": resp.get("result"),
+                        "stats": resp.get("stats"),
+                        "cache_hit": bool(resp.get("cache_hit"))}
+            if state in ("failed", "cancelled", "shed"):
+                self._try_ack(addr, req_id)
+                raise wire.classified_error(resp.get("classified"))
+            if state == "running":
+                observed_running = True
+            time.sleep(poll_interval_s())
+
+    def _on_replica_death(self, tenant: str, rank: int,
+                          addr: Tuple[str, int], req_id: str,
+                          observed_running: bool) -> Optional[Dict]:
+        if not observed_running:
+            # queued-not-dispatched: the caller re-routes.  The replica
+            # may be merely UNREACHABLE (3 failed RPCs, not yet fenced)
+            # rather than dead — best-effort cancel the queued ticket
+            # first, so a replica that recovers does not run work the
+            # survivor is about to run too (swallowed if it really died)
+            self._try_cancel(addr, req_id)
+            return None
+        # in-flight on a dead mesh: abandon, don't retry — re-running
+        # half-finished device work into a fresh replica is the desync
+        # the PR-6 contract bans; the CALLER retries with a fresh
+        # classified hint (completed passes are journaled, so the retry
+        # is cheap)
+        self._try_cancel(addr, req_id)
+        with self._router_lock:
+            self._route_counts["abandoned"] += 1
+        obs_metrics.counter_add("router.abandoned")
+        obs_spans.instant("router.abandoned", tenant=tenant,
+                          dead_replica=rank)
+        raise CylonError(
+            Code.Unavailable,
+            f"replica {rank} died with this request in flight (tenant "
+            f"{tenant!r}); in-flight work is abandoned, not retried — "
+            f"resubmit to replay journaled passes",
+            retry_after_s=self._retry_after(0))
+
+    def _try_cancel(self, addr: Tuple[str, int],
+                    req_id: Optional[str],
+                    token: Optional[str] = None) -> None:
+        """Best-effort cancel by ``req_id`` or by idempotency ``token``
+        — the token form reaps an orphan whose submit accept reply was
+        lost (the router never learned its req_id)."""
+        obj: Dict = {"cmd": "cancel"}
+        if req_id is not None:
+            obj["req_id"] = req_id
+        if token is not None:
+            obj["token"] = token
+        try:
+            control.request(addr, obj, timeout=rpc_timeout_s(),
+                            retries=0, max_line=self.SERVER_MAX_LINE)
+        except OSError:
+            pass  # the replica is gone; nothing to cancel
+
+    def _try_ack(self, addr: Tuple[str, int], req_id: str) -> None:
+        """Terminal reply read: tell the replica the ticket may drop.
+        Best-effort — an unacked terminal ticket ages out past the
+        replica's TICKET_CAP."""
+        try:
+            control.request(addr, {"cmd": "ack", "req_id": req_id},
+                            timeout=rpc_timeout_s(), retries=0,
+                            max_line=self.SERVER_MAX_LINE)
+        except OSError:
+            pass  # ack is insurance, not a contract
+
+    # -- introspection -----------------------------------------------------
+
+    def router_status(self) -> Dict:
+        """The routing table the ``status`` verb ships and
+        ``tools/fleet_status.py --replicas`` renders: per-replica
+        capacity/depth/headroom plus served/shed/re-route counters and
+        the current affinity pins."""
+        view = self._replica_view()
+        with self._router_lock:
+            counts = dict(self._route_counts)
+            per = {r: dict(c) for r, c in sorted(self._per_replica.items())}
+            tenants = dict(self._tenant_affinity)
+            keys = len(self._key_affinity)
+            inflight = dict(self._inflight)
+        replicas = {}
+        for rank, v in sorted(view.items()):
+            replicas[str(rank)] = {
+                "addr": f"{v['addr'][0]}:{v['addr'][1]}",
+                "capacity": v["capacity"],
+                "queue_depth": v["reported_depth"],
+                "router_inflight": inflight.get(rank, 0),
+                "hbm_headroom_bytes": v["headroom"],
+                **per.get(rank, _PER_REPLICA_ZERO),
+                "tenants_pinned": sorted(
+                    t for t, r in tenants.items() if r == rank),
+            }
+        return {"replicas": replicas, "replicas_live": len(view),
+                "cache_affinity": cache_affinity_enabled(),
+                "key_pins": keys, **counts}
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class RouterClient:
+    """Caller-side handle for the ``route`` verb: encodes the request
+    onto the wire (`cylon_tpu.router.wire`), ships it, blocks for the
+    reply, and re-raises classified failures as `CylonError` —
+    callers see the same contract `QueryService.submit(...).result()`
+    gives them locally, with the fleet behind it."""
+
+    def __init__(self, address, timeout_s: Optional[float] = None):
+        if isinstance(address, (tuple, list)):
+            self._addr: Tuple[str, int] = (str(address[0]),
+                                           int(address[1]))
+        else:
+            host, _, port = str(address).rpartition(":")
+            if not host or not port:
+                raise CylonError(Code.Invalid,
+                                 f"bad router address {address!r} "
+                                 f"(want host:port)")
+            self._addr = (host, int(port))
+        self._timeout = timeout_s
+
+    def route(self, tenant: str, op: str, *args,
+              deadline_s: Optional[float] = None,
+              timeout_s: Optional[float] = None, **kwargs):
+        """One routed request: returns ``(result, stats)`` with
+        ``stats["router"]`` carrying the serving replica, re-route
+        count, and cache-hit flag; raises the classified `CylonError`
+        on shed/failure/timeout.  The active trace context rides the
+        verb (net/control.py), so the routed run joins the caller's
+        trace."""
+        payload = wire.encode_payload(args, kwargs)
+        obj: Dict = {"cmd": "route", "tenant": str(tenant),
+                     "op": str(op)}
+        if deadline_s is not None:
+            obj["deadline_s"] = float(deadline_s)
+        cap = router_max_line()
+        # the base64 payload dominates the encoded line; estimating its
+        # size skips a second json.dumps of the whole object on the hot
+        # path (send_json performs the ONLY full serialization).  The
+        # non-payload fields are measured EXACTLY — a pathological
+        # tenant/op string must hit this classified refusal too, not a
+        # server-side connection drop read as retryable
+        nbytes = (wire.payload_nbytes(payload)
+                  + len(json.dumps(obj, sort_keys=True)))
+        obj["payload"] = payload
+        if nbytes + 1024 > cap:
+            raise CylonError(
+                Code.SerializationError,
+                f"encoded route request is ~{nbytes} bytes — past the "
+                f"{cap}-byte CYLON_TPU_ROUTER_MAX_LINE_BYTES wire cap; "
+                f"raise the knob (router AND replicas) or ship less "
+                f"data per request")
+        # ~2x input residency is the serve layer's admission estimate;
+        # base64 already inflated the frames 4/3, so the encoded line
+        # length is the right order of magnitude for the headroom guard
+        obj["est_bytes"] = 2 * nbytes
+        budget = deadline_s if deadline_s is not None \
+            else route_timeout_s()
+        timeout = timeout_s if timeout_s is not None \
+            else (self._timeout if self._timeout is not None
+                  else budget + 30.0)
+        try:
+            # retries=0 ON PURPOSE: the route verb blocks server-side
+            # for the whole proxied run, so a transparent resend of the
+            # line would start a SECOND placement while the first
+            # handler thread may still be driving the original to
+            # completion.  A dropped connection surfaces classified and
+            # retryable instead — the caller's resubmit replays
+            # journaled passes, it does not double device work.
+            resp = control.request(self._addr, obj, timeout=timeout,
+                                   retries=0, max_line=cap)
+        except control.ProtocolError as e:
+            # the REPLY outgrew this client's cap (the router's own cap
+            # may be higher — knobs are read per process): deterministic,
+            # a retry hits the same wall, so never classified retryable
+            raise CylonError(
+                Code.SerializationError,
+                f"routed reply exceeds this client's {cap}-byte "
+                f"CYLON_TPU_ROUTER_MAX_LINE_BYTES wire cap ({e}); raise "
+                f"the knob (client, router AND replicas) or ship less "
+                f"data per request") from e
+        except OSError as e:
+            raise CylonError(
+                Code.Unavailable,
+                f"query router at {self._addr[0]}:{self._addr[1]} "
+                f"unreachable or dropped mid-route "
+                f"({type(e).__name__}: {e}); the routed request may "
+                f"still complete server-side — a resubmit replays "
+                f"journaled passes, never re-executes them") from e
+        if not resp.get("ok"):
+            if resp.get("status") == "stale_coordinator":
+                # PR-11 split-brain: a superseded router incarnation is
+                # still bound — retryable, not a caller bug
+                raise CylonError(
+                    Code.Unavailable,
+                    f"query router at {self._addr[0]}:{self._addr[1]} "
+                    f"answered stale (superseded by incarnation "
+                    f"{resp.get('incarnation')}); re-resolve the router "
+                    f"address and retry", retry_after_s=1.0)
+            if "classified" in resp:
+                raise wire.classified_error(resp["classified"])
+            raise CylonError(Code.UnknownError,
+                             f"route failed: {resp.get('error', resp)}")
+        result = wire.decode_value(resp.get("result"))
+        stats = dict(resp.get("stats") or {})
+        stats["router"] = {"replica": resp.get("replica"),
+                           "reroutes": resp.get("reroutes", 0),
+                           "cache_hit": bool(resp.get("cache_hit"))}
+        return result, stats
+
+    def status(self, timeout_s: float = 5.0) -> Dict:
+        return control.request(self._addr, {"cmd": "status"},
+                               timeout=timeout_s,
+                               max_line=router_max_line())

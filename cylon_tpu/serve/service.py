@@ -194,12 +194,14 @@ class Ticket:
 
     def __init__(self, service: "QueryService", tenant: str, op: str,
                  args, kwargs,
-                 trace: Optional[tracectx.TraceContext] = None):
+                 trace: Optional[tracectx.TraceContext] = None,
+                 deadline_s: Optional[float] = None):
         self._service = service
         self.tenant = tenant
         self.op = op
         self.args = args
         self.kwargs = kwargs
+        self.deadline_s = deadline_s  # per-REQUEST budget override
         self.state = QUEUED
         self.result_value = None
         self.stats: Optional[dict] = None
@@ -339,6 +341,7 @@ class QueryService:
         self._draining = False
         self._closed = False
         self._ewma_s: Optional[float] = None
+        self._runners: Dict[str, object] = {}  # instance op overrides
         self._pending_flight: List[dict] = []  # staged shed dumps
         self._counts = {"admitted": 0, "shed": 0, "completed": 0,
                         "failed": 0, "cancelled": 0, "cache_hits": 0,
@@ -426,7 +429,7 @@ class QueryService:
     def _submit_inner(self, tenant: str, op: str, *args,
                       **kwargs) -> Ticket:
         tenant = str(tenant)
-        if op not in _RUNNERS:
+        if op not in _RUNNERS and op not in self._runners:
             raise CylonError(Code.Invalid,
                              f"unknown op {op!r} (expected one of {OPS})")
         # mint the request's causal trace BEFORE any admission decision,
@@ -439,6 +442,14 @@ class QueryService:
         parent = tracectx.parse_or_none(kwargs.pop("traceparent", None))
         trace = parent.child() if parent is not None \
             else tracectx.new_trace()
+        # reserved kwarg: a per-REQUEST wall-clock budget that overrides
+        # the tenant/knob default — the router forwards a client's
+        # deadline through its extra hop with it, so the budget that
+        # fires is the one the CALLER set, not whatever the replica's
+        # tenant table happens to say
+        deadline_override = kwargs.pop("deadline_s", None)
+        if deadline_override is not None:
+            deadline_override = max(0.0, float(deadline_override))
 
         def shed_now(err: CylonError) -> CylonError:
             # an admission shed has no Ticket to close the trace through:
@@ -505,7 +516,8 @@ class QueryService:
                         f"HBM admission estimate {est} + live {live} "
                         f"exceeds the {hbm_cap}-byte tenant budget",
                         self._retry_after(depth + 1), trace))
-            ticket = Ticket(self, tenant, op, args, kwargs, trace=trace)
+            ticket = Ticket(self, tenant, op, args, kwargs, trace=trace,
+                            deadline_s=deadline_override)
             self._queue.append(ticket)
             st.queued += 1
             st.admitted += 1
@@ -613,9 +625,19 @@ class QueryService:
             return max(0.0, float(b.deadline_s))
         return default_deadline_s()
 
+    def register_op(self, op: str, runner) -> "QueryService":
+        """Instance-scoped op registration: like the module-level
+        :func:`register_op` but visible only to THIS service — two
+        replicas in one process (the router tests' rendering) can serve
+        the same op name through different runners."""
+        with self._lock:
+            self._runners[str(op)] = runner
+        return self
+
     def _run_ticket(self, ticket: Ticket) -> None:
         tenant = ticket.tenant
-        deadline_s = self._request_deadline_s(tenant)
+        deadline_s = ticket.deadline_s if ticket.deadline_s is not None \
+            else self._request_deadline_s(tenant)
         dl = durable.PassDeadline(deadline_s, f"serve.request.{tenant}") \
             if deadline_s > 0 else None
 
@@ -640,7 +662,7 @@ class QueryService:
         ticket.queue_wait_s = max(0.0, t0 - ticket.t_submit)
         obs_metrics.hist_observe(_slo_key("queue_wait_ms", tenant),
                                  ticket.queue_wait_s * 1e3)
-        runner = _RUNNERS[ticket.op]
+        runner = self._runners.get(ticket.op) or _RUNNERS[ticket.op]
         # the request's trace context is ACTIVE for the whole execution:
         # every span the engine records on this thread (plan passes,
         # exec passes, shuffle collectives) becomes a child span of this

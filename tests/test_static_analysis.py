@@ -385,6 +385,101 @@ def test_cy107_only_fires_under_the_serve_package(tmp_path):
     assert "CY107" not in {f.rule for f in found}
 
 
+def _scan_router(tmp_path, src, name="service.py", extra=()):
+    """CY110 fixtures must live under cylon_tpu/router/ for the module
+    name to resolve into the router namespace; ``extra`` adds sibling
+    fixture files to the same scan (cross-module reachability)."""
+    d = tmp_path / "cylon_tpu" / "router"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(src))
+    paths = [str(p)]
+    for rel, esrc in extra:
+        ep = tmp_path / "cylon_tpu" / rel
+        ep.parent.mkdir(parents=True, exist_ok=True)
+        ep.write_text(textwrap.dedent(esrc))
+        paths.append(str(ep))
+    return astlint.scan_paths(paths)
+
+
+def test_cy110_blocking_device_call_on_route_path(tmp_path):
+    found = _scan_router(tmp_path, """\
+        import jax
+
+        def _fetch(x):
+            return jax.block_until_ready(x)
+
+        class Router:
+            def route(self, req):
+                return self._place_candidates(req)
+
+            def _place_candidates(self, req):
+                return _fetch(req)
+        """)
+    # both the route root and the _place* helper reach the blocking
+    # call (self.X calls resolve against same-module functions)
+    assert _rules_at(found) == [("CY110", 7), ("CY110", 10)]
+    assert "block_until_ready" in found[0].msg
+    assert "placement" in found[0].msg
+
+
+def test_cy110_replica_executor_device_work_is_clean(tmp_path):
+    # device work behind the proxy verbs (a non-control-path name) is
+    # the design; only route/placement/reroute/handler roots must stay
+    # device-free
+    found = _scan_router(tmp_path, """\
+        import jax
+
+        class Router:
+            def route(self, req):
+                return self._place(req)
+
+            def _place(self, req):
+                return sorted(req)
+
+            def run_ticket_on_replica(self, x):
+                return jax.device_get(x)
+        """)
+    assert found == []
+
+
+def test_cy110_only_fires_under_the_router_package(tmp_path):
+    found = _scan(tmp_path, """\
+        import jax
+
+        def route(req):
+            return jax.block_until_ready(req)
+        """)
+    assert "CY110" not in {f.rule for f in found}
+
+
+def test_cy110_arrow_ipc_decode_is_a_host_only_barrier(tmp_path):
+    """pyarrow's ``Array.to_numpy`` (the wire codec's IPC decode in
+    io/arrow_io.py) shares its final identifier with the device fetch:
+    the declared host-only module barrier must keep the handler paths
+    riding it clean, while a DIRECT device call still fires."""
+    arrow = ("io/arrow_io.py", """\
+        def frame_from_ipc_bytes(payload):
+            return {f.name: arr.to_numpy() for f, arr in payload}
+        """)
+    found = _scan_router(tmp_path, """\
+        from cylon_tpu.io.arrow_io import frame_from_ipc_bytes
+
+        def _handle_submit(req):
+            return frame_from_ipc_bytes(req["payload"])
+        """, extra=[arrow])
+    assert "CY110" not in {f.rule for f in found}
+    found = _scan_router(tmp_path, """\
+        import jax
+        from cylon_tpu.io.arrow_io import frame_from_ipc_bytes
+
+        def _handle_submit(req):
+            return jax.device_put(frame_from_ipc_bytes(req["payload"]))
+        """, extra=[arrow])
+    assert [f.rule for f in found] == ["CY110"]
+    assert "device_put" in found[0].msg
+
+
 def _scan_plan(tmp_path, src, name="executor.py"):
     """CY108 fixtures must live under cylon_tpu/plan/ for the module
     name to resolve into the planner namespace."""

@@ -18,9 +18,15 @@ picks up, or eyeball it.
 Pure stdlib (no jax, no package import) so it runs anywhere a socket
 reaches the coordinator.
 
+``--replicas`` renders the fleet query router's routing table instead
+(the ``router`` section a `QueryRouter` adds to the ``status`` verb):
+per-replica capacity, live queue depth, HBM headroom, tenant-affinity
+pins, and per-replica served/shed/re-route counters.
+
 Usage:
     python tools/fleet_status.py HOST:PORT [--json] [--openmetrics]
-                                 [--timeout S] [--max-reply-bytes N]
+                                 [--replicas] [--timeout S]
+                                 [--max-reply-bytes N]
 """
 from __future__ import annotations
 
@@ -93,6 +99,52 @@ def _hist_line(h: Dict) -> str:
             f"max={float(h.get('max') or 0.0):8.1f}ms")
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return str(n)
+
+
+def render_replicas(st: Dict) -> str:
+    """The query router's routing table (the ``router`` section a
+    `QueryRouter`'s ``status`` verb adds): per-replica capacity, live
+    queue depth, HBM headroom, tenant-affinity pins and per-replica
+    served/shed/re-route counters."""
+    rt = st.get("router")
+    if not isinstance(rt, dict):
+        return ("no routing table: the coordinator at this address is "
+                "not a query router")
+    lines = [f"router: {rt.get('replicas_live', 0)} live replica(s), "
+             f"routed={rt.get('routed', 0)} sheds={rt.get('sheds', 0)} "
+             f"reroutes={rt.get('reroutes', 0)} "
+             f"abandoned={rt.get('abandoned', 0)}  "
+             f"cache_affinity={'on' if rt.get('cache_affinity') else 'off'}"
+             f" ({rt.get('key_pins', 0)} fingerprint pin(s))"]
+    reps = rt.get("replicas") or {}
+    if not reps:
+        lines.append("  (no serving replicas registered)")
+        return "\n".join(lines)
+    lines.append(f"  {'rank':>4s} {'addr':>21s} {'cap':>4s} {'depth':>6s} "
+                 f"{'hbm headroom':>13s} {'served':>7s} {'shed':>5s} "
+                 f"{'rerouted':>9s}  tenants pinned")
+    for r, row in sorted(reps.items(), key=lambda kv: int(kv[0])):
+        depth = (f"{row.get('queue_depth', 0)}"
+                 f"+{row.get('router_inflight', 0)}")
+        pins = ", ".join(row.get("tenants_pinned") or []) or "-"
+        lines.append(
+            f"  {r:>4s} {row.get('addr', '?'):>21s} "
+            f"{row.get('capacity', 0):>4d} {depth:>6s} "
+            f"{_fmt_bytes(row.get('hbm_headroom_bytes')):>13s} "
+            f"{row.get('served', 0):>7d} {row.get('shed', 0):>5d} "
+            f"{row.get('rerouted_away', 0):>9d}  {pins}")
+    return "\n".join(lines)
+
+
 def render(st: Dict) -> str:
     lines = []
     lines.append(f"incarnation {st.get('incarnation', 0)}  "
@@ -154,12 +206,23 @@ def main(argv=None) -> int:
                     help="fleet-wide Prometheus text exposition from the "
                          "coordinator's metrics verb (rank-labeled "
                          "samples) instead of the status view")
+    ap.add_argument("--replicas", action="store_true",
+                    help="render the query router's routing table (per-"
+                         "replica capacity, queue depth, HBM headroom, "
+                         "affinity pins, shed/served counters) instead "
+                         "of the membership view")
     ap.add_argument("--max-reply-bytes", type=int,
                     default=DEFAULT_MAX_REPLY,
                     help="cap on one coordinator reply; past it the "
                          "reply is truncated with a warning instead of "
                          "a hard failure (default 64 MiB)")
     args = ap.parse_args(argv)
+    if args.openmetrics and args.replicas:
+        # the two views render different verbs — a silently dropped
+        # flag would read as "my routing table is the exposition"
+        print("fleet_status: --replicas and --openmetrics are separate "
+              "views; pass one at a time", file=sys.stderr)
+        return 2
     if args.openmetrics:
         # one representation per reply: exposition text by default, raw
         # per-rank snapshots under --json (the coordinator ships only
@@ -191,6 +254,17 @@ def main(argv=None) -> int:
             return 1
         sys.stdout.write(text)
         return 0
+    if args.replicas:
+        # rc parity with text mode: "not a query router" is rc 1 in
+        # BOTH renderings — a script probing with --json must not read
+        # success with null output
+        rt = st.get("router")
+        if args.json:
+            json.dump(rt, sys.stdout, indent=1, sort_keys=True)
+            print()
+            return 0 if isinstance(rt, dict) else 1
+        print(render_replicas(st))
+        return 0 if isinstance(rt, dict) else 1
     if args.json:
         json.dump(st, sys.stdout, indent=1, sort_keys=True)
         print()

@@ -497,6 +497,147 @@ if [ $rc -ne 0 ]; then
   echo "serve smoke failed (rc=$rc); fix the query service before the full tree" >&2
   exit $rc
 fi
+# router smoke (ISSUE-14): a QueryRouter fronting 2 replica worker
+# PROCESSES sharing one durable journal, flooded by 12 traced requests
+# while a seeded rank_kill takes replica 1 down at its 2nd dispatch —
+# asserts the re-route counter >= 1, fleet served == submitted minus
+# classified sheds (zero hangs, zero unclassified failures), a repeated
+# fingerprint served as a cache hit on the survivor, and ONE trace_id
+# spanning router + both replicas in the merged timeline (the killed
+# replica exports incrementally, so its spans survive os._exit)
+RT=$(mktemp -d /tmp/cylon_router_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_TRACE=1 CYLON_TPU_TRACE_DIR="$RT/traces" \
+    CYLON_TPU_DURABLE_DIR="$RT/journal" \
+    python - "$RT" <<'PYEOF'
+import json, os, subprocess, sys, threading, time
+
+sys.path.insert(0, os.getcwd())
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from cylon_tpu import elastic
+from cylon_tpu.obs import export, metrics as obs_metrics, tracectx
+from cylon_tpu.router import QueryRouter, RouterClient
+from cylon_tpu.status import Code, CylonError
+
+td = sys.argv[1]
+router = QueryRouter(world=3, heartbeat_timeout_s=2.5).start()
+addr = f"{router.address[0]}:{router.address[1]}"
+base_env = {k: v for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS",
+                         "CYLON_TPU_FAULT_PLAN")}
+base_env.update(CYLON_TPU_HEARTBEAT_S="0.1",
+                CYLON_TPU_HEARTBEAT_TIMEOUT_S="2.5",
+                CYLON_TPU_COORD_RECONNECT_S="0")
+procs = []
+for r in range(2):
+    env = dict(base_env)
+    if r == 1:
+        env["CYLON_TPU_FAULT_PLAN"] = "router.pass.r1@2=rank_kill"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "tests.router_worker", str(r), "3", addr],
+        env=env))
+try:
+    agent = elastic.Agent(addr, 2, interval_s=0.1, timeout_s=2.5,
+                          reconnect_s=0.0).start()
+    deadline = time.monotonic() + 120
+    while router.router_status()["replicas_live"] < 2:
+        assert time.monotonic() < deadline, "replicas never registered"
+        time.sleep(0.1)
+    cli = RouterClient(addr)
+    def mk(seed):
+        r = np.random.default_rng(seed)
+        n = 1200
+        return ({"k": r.integers(0, n, n).astype(np.int64),
+                 "a": r.random(n).astype(np.float32)},
+                {"k": r.integers(0, n, n).astype(np.int64),
+                 "b": r.random(n).astype(np.float32)})
+    inputs = [mk(100 + i) for i in range(4)]
+    root = tracectx.new_trace()
+    served, errs, lock = [], [], threading.Lock()
+    def one(i):
+        l, r = inputs[i % 4]
+        with tracectx.activate(root):
+            try:
+                res, stats = cli.route(f"tenant-{i % 4}", "kjoin", l, r,
+                                       on="k", passes=2, mode="hash",
+                                       timeout_s=300)
+                with lock:
+                    served.append((i, stats))
+            except CylonError as e:
+                with lock:
+                    errs.append((i, e))
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(360)
+    assert all(not t.is_alive() for t in threads), "a routed request hung"
+    for i, e in errs:
+        assert e.code in (Code.ResourceExhausted, Code.Unavailable,
+                          Code.Timeout), (i, e)
+    assert len(served) + len(errs) == 12
+    rr = obs_metrics.counter_value("router.reroutes")
+    assert rr >= 1, f"no re-route observed (reroutes={rr})"
+    st = router.router_status()
+    assert st["routed"] == len(served), (st, len(served))
+    # the repeated fingerprint: a cache hit on the SURVIVOR, served
+    # from the shared journal no matter which replica executed it
+    l, r = inputs[0]
+    with tracectx.activate(root):
+        res, stats = cli.route("tenant-0", "kjoin", l, r, on="k",
+                               passes=2, mode="hash", timeout_s=300)
+    assert stats["router"]["replica"] == 0, stats["router"]
+    assert stats["router"]["cache_hit"] is True, stats["router"]
+    export.export_trace(rank=2)
+    with open(f"{td}/summary.json", "w") as fh:
+        json.dump({"trace_id": root.trace_id, "served": len(served),
+                   "sheds": len(errs), "reroutes": rr,
+                   "router": st}, fh, indent=1, sort_keys=True)
+finally:
+    router.stop()
+    for p in procs:
+        try:
+            p.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+assert procs[0].returncode == 0, procs[0].returncode
+assert procs[1].returncode == 137, procs[1].returncode
+print(f"router smoke: {len(served)}/12 served, {len(errs)} classified "
+      f"shed(s), {int(rr)} reroute(s), repeat = cache hit on survivor")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "router smoke (run) failed (rc=$rc); fix the query router before the full tree" >&2
+  rm -rf "$RT"; exit $rc
+fi
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/trace_merge.py "$RT/traces" -o "$RT/merged.json" --json \
+    > "$RT/merge_summary.json" \
+  && python - "$RT" <<'PYEOF'
+import json, sys
+td = sys.argv[1]
+summary = json.load(open(f"{td}/merge_summary.json"))
+assert summary["aligned"] is True, summary
+root = json.load(open(f"{td}/summary.json"))["trace_id"]
+merged = json.load(open(f"{td}/merged.json"))
+pids = sorted({e["pid"] for e in merged["traceEvents"]
+               if (e.get("args") or {}).get("trace_id") == root})
+# ONE causally-linked trace through the extra hop: the router (rank 2)
+# and BOTH replicas — including the killed one, whose incremental
+# exports preserved its completed-request spans
+assert pids == [0, 1, 2], f"trace does not span router+replicas: {pids}"
+print(f"router smoke ok: trace {root[:16]}... spans router + both "
+      f"replicas (pids {pids}) in the merged timeline")
+PYEOF
+rc=$?
+rm -rf "$RT"
+if [ $rc -ne 0 ]; then
+  echo "router smoke (merge) failed (rc=$rc); fix router trace propagation before the full tree" >&2
+  exit $rc
+fi
 # planner smoke (ISSUE-9): TPC-H Q10 (4-way join) through the logical
 # planner on the world-8 CPU mesh — the artifact JSON must record at
 # least one elided shuffle and the planned result must be bit-identical
