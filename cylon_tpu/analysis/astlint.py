@@ -44,6 +44,8 @@ RULES: Dict[str, str] = {
     "CY109": "realized-data jit layout missing from a plan cache key",
     "CY110": "blocking device call reachable from a router "
              "route/placement/reroute control path",
+    "CY111": "blocking RPC or fsync reachable while a placement/"
+             "membership lock is held",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
 }
@@ -111,6 +113,21 @@ ROUTER_MODULE_PREFIX = "cylon_tpu.router"
 ROUTER_CONTROL_ROOTS = frozenset({"route"})
 ROUTER_CONTROL_PREFIXES = ("_place", "_reroute", "_proxy", "_route",
                            "_shed", "_handle", "_on_replica")
+
+#: modules in scope for CY111 — the router tier (placement lock
+#: ``_router_lock`` + the inherited membership lock ``_lock``) and the
+#: durable journal (the GC-lease eviction path).  The PR-16 hedge,
+#: breaker and lease control paths all mutate shared dicts under a
+#: lock; a blocking RPC or an fsync issued while that lock is held
+#: turns one slow replica or one slow disk into a fleet-wide placement
+#: stall — exactly the tail the hedging exists to cut off
+CY111_MODULE_PREFIXES = ("cylon_tpu.router", "cylon_tpu.durable")
+
+#: call finals that block the lock holder for CY111: the one-shot
+#: control-plane RPC (``net/control.request``) and the journal's
+#: ``os.fsync`` — both wait on a peer or a disk, neither belongs under
+#: a lock every routing decision shares
+LOCK_HELD_BLOCKING_NAMES = frozenset({"request", "fsync"})
 
 #: the planner package and its rule/executor roots, for CY108: the plan
 #: FINGERPRINT is the durable/serve result-cache key for whole planned
@@ -1035,6 +1052,131 @@ def _check_router_blocking(prog: _Program, mod: _Module) -> None:
                 "host-only"))
 
 
+def _lock_ctx_name(item: ast.withitem) -> Optional[str]:
+    """The dotted name of a with-item whose final attribute names a
+    lock (``self._router_lock``, ``self._lock``, ``some_lock``), else
+    None.  Matching is lexical by design: the placement and membership
+    locks are attributes, never passed around, so the name IS the
+    identity — and a lock-protocol object hidden behind a non-lock name
+    is its own review finding, not this rule's."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    dotted = _dotted(expr)
+    if dotted and "lock" in dotted.rsplit(".", 1)[-1].lower():
+        return dotted
+    return None
+
+
+def _calls_in_block(body: Sequence[ast.AST], mod: _Module):
+    """(resolved quals, final identifiers) of calls LEXICALLY inside
+    the statements — nested function/lambda bodies are skipped (they
+    run later, not under the lock)."""
+    quals: Set[str] = set()
+    finals: Set[str] = set()
+    stack: List[ast.AST] = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            dotted = _dotted(n.func)
+            resolved = _resolve(dotted, mod.aliases)
+            final = (dotted or "").rsplit(".", 1)[-1]
+            if final:
+                finals.add(final)
+            if dotted and "." not in dotted:
+                quals.add(f"{mod.name}.{dotted}")
+            elif resolved:
+                quals.add(resolved)
+        stack.extend(ast.iter_child_nodes(n))
+    return quals, finals
+
+
+def _lock_held_blocking_reach(prog: _Program, module: str,
+                              quals: Set[str],
+                              finals: Set[str]) -> Set[str]:
+    """Blocking-under-lock calls reachable from a with-lock body: the
+    CY110 walk (self/cls resolution, host-only barriers) re-aimed at
+    the RPC/fsync final set, seeded from the block's lexical calls."""
+    hit: Set[str] = set(finals & LOCK_HELD_BLOCKING_NAMES)
+    seen: Set[str] = set()
+    stack: List[str] = []
+    for c in quals:
+        if c.startswith(("self.", "cls.")):
+            c = f"{module}.{c.split('.', 1)[1]}"
+        stack.append(c)
+    while stack:
+        q = stack.pop()
+        if q in seen:
+            continue
+        seen.add(q)
+        fn = prog.by_qual.get(q)
+        if fn is None or fn.module in HOST_ONLY_MODULES:
+            continue
+        hit |= fn.call_finals & LOCK_HELD_BLOCKING_NAMES
+        for c in fn.calls:
+            if c.startswith(("self.", "cls.")):
+                c = f"{fn.module}.{c.split('.', 1)[1]}"
+            stack.append(c)
+    return hit
+
+
+def _check_lock_held_blocking(prog: _Program, mod: _Module) -> None:
+    """CY111: a ``with <lock>:`` body in the router tier or the
+    durable journal from which a blocking control-plane RPC
+    (``request``) or an ``fsync`` is reachable — the CY110 walk turned
+    inward, at lock-held regions instead of control-path roots.
+
+    The invariant: the placement lock (``_router_lock``) and the
+    inherited membership lock (``_lock``) serialize EVERY routing
+    decision; the hedge/breaker/GC-lease paths added in PR-16 take
+    them on every request.  An RPC or an fsync issued while one is
+    held converts one slow peer or one slow disk into a fleet-wide
+    placement stall — breaker transitions and lease bookkeeping must
+    be host-only dict flips, with the blocking work outside the
+    ``with``."""
+    if not mod.name.startswith(CY111_MODULE_PREFIXES):
+        return
+    for f in mod.funcs.values():
+        # lexical With scan that does NOT descend into nested defs —
+        # each nested def is its own _Func and scans its own body
+        stack: List[ast.AST] = (list(ast.iter_child_nodes(f.node))
+                                if isinstance(
+                                    f.node,
+                                    (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) else [])
+        withs: List[ast.With] = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                withs.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for w in withs:
+            locks = [nm for nm in (_lock_ctx_name(i) for i in w.items)
+                     if nm]
+            if not locks:
+                continue
+            quals, finals = _calls_in_block(w.body, mod)
+            hit = _lock_held_blocking_reach(prog, f.module, quals,
+                                            finals)
+            if hit:
+                name = f.qual.rsplit(".", 1)[-1]
+                mod.findings.append(Finding(
+                    "CY111", mod.path, w.lineno,
+                    f"`with {locks[0]}:` in `{name}` reaches blocking "
+                    f"call(s) {', '.join(sorted(hit))} while the lock "
+                    f"is held — one slow peer or disk would stall "
+                    f"every routing decision behind it",
+                    "do the RPC/fsync outside the with block; "
+                    "lock-held regions must be host-only dict flips "
+                    "(snapshot under the lock, block after release)"))
+
+
 def _check_plan_fingerprint(prog: _Program, mod: _Module) -> None:
     """CY108: a plan-optimizer rule or executor path (module under
     ``cylon_tpu.plan``; roots ``optimize``/``execute``/``run_service``
@@ -1127,6 +1269,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_elastic_guards(prog, mod)
         _check_serve_blocking(prog, mod)
         _check_router_blocking(prog, mod)
+        _check_lock_held_blocking(prog, mod)
         _check_plan_fingerprint(prog, mod)
         for f in mod.funcs.values():
             if f.qual in traced:

@@ -407,6 +407,41 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "tables, unlike the 1 MiB control-plane default).  A single "
             "request larger than this is rejected with a classified "
             "SerializationError, never silently truncated."),
+    _K("CYLON_TPU_ROUTER_HEDGE_MS", "float", 0.0, RUNTIME,
+       accessors=("cylon_tpu.router.service.hedge_floor_ms",),
+       help="Hedged requests: milliseconds after the primary submit "
+            "before the router speculatively re-places an in-flight "
+            "request on a second replica (the floor under the per-"
+            "fingerprint asymmetric-EWMA p99 delay; first terminal "
+            "ticket wins, the loser is proxy-cancelled at a pass "
+            "boundary).  Safe only because journaled built-in ops are "
+            "fingerprint-idempotent and bit-identical across replicas; "
+            "custom register_op handlers hedge only when registered "
+            "with idempotent=True.  0 (default) disables hedging."),
+    _K("CYLON_TPU_ROUTER_BREAKER_FAILURES", "int", 3, RUNTIME,
+       accessors=("cylon_tpu.router.service.breaker_failures",),
+       help="Replica health breakers: consecutive classified failures "
+            "(Timeout/Unavailable/UnknownError, a lost hedge race, or "
+            "sustained p99 inflation) before a replica's breaker OPENs "
+            "and placement skips it.  Composes with — never overrides "
+            "— fencing/affinity/saturation.  0 disables the breakers."),
+    _K("CYLON_TPU_ROUTER_BREAKER_COOLDOWN_S", "float", 5.0, RUNTIME,
+       accessors=("cylon_tpu.router.service.breaker_cooldown_s",),
+       help="Seconds an OPEN replica breaker holds before HALF_OPEN "
+            "admits exactly one real request as a health probe: a "
+            "clean probe re-CLOSEs the breaker, a failed (or "
+            "hedge-beaten) probe re-OPENs it for another cooldown."),
+    _K("CYLON_TPU_DURABLE_QUOTA_BYTES", "int", 0, RUNTIME,
+       accessors=("cylon_tpu.durable.quota_bytes",),
+       help="Hard disk budget for new journal spills under the shared "
+            "CYLON_TPU_DURABLE_DIR: a spill that would push the root "
+            "past it (or a write hitting real ENOSPC) classifies "
+            "Code.ResourceExhausted and the run degrades to journal-"
+            "off execution — the answer is still served (counted "
+            "durable.degraded), the query never fails for disk.  "
+            "Unlike CYLON_TPU_DURABLE_CAP_BYTES (GC target after the "
+            "fact), the quota refuses the write up front.  0 (default) "
+            "disables."),
     _K("CYLON_TPU_PROFILE", "bool", False, RUNTIME,
        accessors=("cylon_tpu.plan.profile.profiler_enabled",),
        help="Query profiler: collect per-plan-node actuals (rows, self "

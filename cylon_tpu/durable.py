@@ -50,11 +50,13 @@ pass past its deadline (tests/test_durable.py).
 from __future__ import annotations
 
 import contextlib
+import errno
 import hashlib
 import json
 import logging
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -68,6 +70,15 @@ from .status import Code, CylonError
 log = logging.getLogger("cylon_tpu")
 
 MANIFEST = "MANIFEST.jsonl"
+
+#: advisory cross-process GC lease file (journal root); a GC holding a
+#: lease younger than the TTL excludes every other replica's GC
+GC_LOCK = "GC_LOCK"
+_GC_LEASE_TTL_S = 30.0
+
+#: minimum seconds between load-time manifest-mtime freshens (the LRU
+#: clock a long replay must keep advancing under the shared journal)
+_FRESHEN_MIN_S = 5.0
 
 
 # ---------------------------------------------------------------------------
@@ -99,6 +110,15 @@ def cap_bytes() -> int:
     """Journal size cap (``CYLON_TPU_DURABLE_CAP_BYTES``); 0 (default)
     means unbounded — the pre-PR-7 grow-without-bound behavior."""
     return max(0, int(config.knob("CYLON_TPU_DURABLE_CAP_BYTES")))
+
+
+def quota_bytes() -> int:
+    """Hard disk budget for NEW spill writes
+    (``CYLON_TPU_DURABLE_QUOTA_BYTES``); 0 (default) disables.  Unlike
+    ``cap_bytes`` (which the GC enforces *after* the fact by evicting),
+    the quota refuses the write up front — the run degrades to
+    journal-off execution instead of filling a shared disk."""
+    return max(0, int(config.knob("CYLON_TPU_DURABLE_QUOTA_BYTES")))
 
 
 # ---------------------------------------------------------------------------
@@ -244,7 +264,12 @@ class RunJournal:
         self._quarantined: List[dict] = []
         self._last_committed: Optional[str] = None
         self._spill_disabled = False
+        self._degraded = False
         self._done: Optional[dict] = None
+        # lazy journal-root byte inventory for the quota guard (scanned
+        # once per journal, then tracked incrementally for our own writes)
+        self._root_seen_bytes: Optional[int] = None
+        self._freshened_at = 0.0
 
     # -- open / manifest replay -----------------------------------------
 
@@ -368,15 +393,24 @@ class RunJournal:
         path = os.path.join(self.dir, name)
         with obs_spans.span("durable.spill", level=level, part=part,
                             rows=rows):
-            resilience.fault_point("journal_spill")
             try:
                 payload = arrow_io.frame_to_ipc_bytes(frame)
             except Exception as e:
                 self._spill_failed("serialize", name, e)
                 return False
+            if self._quota_exceeded(len(payload)):
+                self._degrade("quota", name,
+                              f"CYLON_TPU_DURABLE_QUOTA_BYTES="
+                              f"{quota_bytes()} would be exceeded by "
+                              f"{len(payload)} more bytes")
+                return False
             digest = hashlib.sha256(payload).hexdigest()
             tmp = path + f".tmp.{os.getpid()}"
             try:
+                # the injected ENOSPC site (fault kind `disk_full`) sits
+                # INSIDE the guarded region: a full disk — real or
+                # seeded — degrades the run, it never fails the pass
+                resilience.fault_point("journal_spill")
                 with open(tmp, "wb") as fh:
                     fh.write(payload)
                     fh.flush()
@@ -410,11 +444,54 @@ class RunJournal:
         return True
 
     def _spill_failed(self, stage: str, name: str, e: Exception) -> None:
+        if getattr(e, "errno", None) == errno.ENOSPC:
+            # a full shared disk is a fleet condition, not a bug: classify
+            # it ResourceExhausted and degrade instead of counting it with
+            # the anonymous spill errors an operator would page on
+            self._degrade(stage, name, f"disk full (ENOSPC): {e}")
+            return
         self._spill_disabled = True
         obs_metrics.counter_add("durable.spill_errors")
         log.warning("durable: %s of %s failed (%s: %s); journaling disabled "
                     "for the rest of this run", stage, name,
                     type(e).__name__, e)
+
+    def _quota_exceeded(self, nbytes: int) -> bool:
+        """True when writing ``nbytes`` more would push the journal root
+        past ``CYLON_TPU_DURABLE_QUOTA_BYTES``.  The root inventory is
+        scanned once per journal and then tracked incrementally for this
+        writer's own spills — best-effort under concurrent writers, which
+        is fine: the quota is a budget, ENOSPC is the backstop."""
+        q = quota_bytes()
+        if q <= 0:
+            return False
+        if self._root_seen_bytes is None:
+            root = os.path.dirname(self.dir)
+            self._root_seen_bytes = sum(
+                r["bytes"] for r in scan_runs(root))
+        if self._root_seen_bytes + nbytes > q:
+            return True
+        self._root_seen_bytes += nbytes
+        return False
+
+    def _degrade(self, stage: str, name: str, why: str) -> None:
+        """Degraded mode: the shared cache is out of disk (ENOSPC or the
+        quota) — stop journaling for this run and keep executing.  The
+        answer is still served; only durability/cache-ability is lost.
+        Classified `Code.ResourceExhausted` in the trace, counted under
+        ``durable.degraded`` — distinct from ``durable.spill_errors``
+        (unexpected IO bugs) so fleet dashboards can alert on disk
+        pressure specifically."""
+        self._spill_disabled = True
+        if self._degraded:
+            return
+        self._degraded = True
+        obs_metrics.counter_add("durable.degraded")
+        obs_spans.instant("durable.degraded", stage=stage, spill=name,
+                          code=Code.ResourceExhausted.name, reason=why)
+        log.warning("durable: %s of %s hit the disk budget (%s); run "
+                    "degrades to journal-off execution [%s]", stage, name,
+                    why, Code.ResourceExhausted.name)
 
     def load_pass(self, level: int, part: int):
         """(frame, rows) for a journaled pass, or None when the pass is
@@ -426,6 +503,13 @@ class RunJournal:
             return None
         from .io import arrow_io
 
+        # LRU clock, load-time half: `_open` freshens the manifest mtime
+        # once, but under the SHARED fleet journal a long replay keeps
+        # reading spills for minutes after its open — without periodic
+        # re-freshening a concurrent replica's GC sees a stale clock and
+        # evicts the hottest run first (throttled: one utime per
+        # _FRESHEN_MIN_S, not per pass)
+        self._freshen()
         path = os.path.join(self.dir, entry["file"])
         with obs_spans.span("durable.load", level=level, part=part):
             try:
@@ -443,6 +527,14 @@ class RunJournal:
                                     f"undecodable spill: "
                                     f"{type(e).__name__}: {e}")
         return frame, int(entry["rows"])
+
+    def _freshen(self) -> None:
+        now = time.monotonic()
+        if now - self._freshened_at < _FRESHEN_MIN_S:
+            return
+        self._freshened_at = now
+        with contextlib.suppress(OSError):
+            os.utime(os.path.join(self.dir, MANIFEST))
 
     def _reject(self, level: int, part: int, why: str):
         self._passes.pop((int(level), int(part)), None)
@@ -554,14 +646,69 @@ def _evict_run_dir(d: str) -> None:
         os.rmdir(d)
 
 
+def _acquire_gc_lease(root: str) -> Optional[str]:
+    """Advisory cross-process GC lease: O_CREAT|O_EXCL on
+    ``<root>/GC_LOCK`` (pid + wall-clock inside, for operators).  Returns
+    the lease path, or None when another replica's GC holds a lease
+    younger than the TTL.  A stale lease (crashed holder) is broken by an
+    atomic rewrite — two breakers racing the rewrite is acceptable for an
+    ADVISORY lease: the per-victim manifest re-read under the lease is
+    what protects correctness, the lease only serializes the common
+    case."""
+    path = os.path.join(root, GC_LOCK)
+    payload = json.dumps({"pid": os.getpid(), "ts": time.time()}) + "\n"
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        try:
+            age = time.time() - os.path.getmtime(path)
+        except OSError:
+            return None  # holder released between exists and stat
+        if age < _GC_LEASE_TTL_S:
+            obs_metrics.counter_add("durable.gc_lease_busy")
+            return None
+        tmp = path + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(payload)
+            os.replace(tmp, path)
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            return None
+        log.warning("durable: broke stale GC lease at %s (age %.1fs)",
+                    path, age)
+        return path
+    except OSError:
+        return None
+    try:
+        os.write(fd, payload.encode())
+    finally:
+        os.close(fd)
+    return path
+
+
+def _release_gc_lease(path: str) -> None:
+    with contextlib.suppress(OSError):
+        os.remove(path)
+
+
 def gc_journal(root: Optional[str] = None,
                cap: Optional[int] = None) -> Tuple[int, int]:
     """Size-cap LRU eviction over the journal root: whole runs are
     evicted least-recently-used first until total bytes fit under
     ``CYLON_TPU_DURABLE_CAP_BYTES`` (or ``cap``).  Returns
     ``(runs_evicted, bytes_freed)``; (0, 0) when no cap is set, the root
-    is unused, or everything already fits.  The currently-open journal
-    (an in-flight run) is never evicted from under its own writer."""
+    is unused, everything already fits, or another replica's GC holds
+    the advisory lease.  The currently-open journal (an in-flight run)
+    is never evicted from under its own writer.
+
+    Fleet discipline (every replica GCs the SHARED root concurrently):
+    destructive eviction runs only under the ``GC_LOCK`` lease, and each
+    victim's manifest mtime is RE-READ immediately before eviction — the
+    CoordLog ownership-re-read pattern — so a run that a third replica
+    opened or replayed (freshening its LRU clock) after our scan is
+    skipped this round instead of half-evicted under a reader."""
     root = durable_dir() if root is None else root
     cap = cap_bytes() if cap is None else max(0, int(cap))
     if not root or cap <= 0:
@@ -570,19 +717,35 @@ def gc_journal(root: Optional[str] = None,
     total = sum(r["bytes"] for r in runs)
     if total <= cap:
         return 0, 0
+    lease = _acquire_gc_lease(root)
+    if lease is None:
+        return 0, 0
     live = _LAST_JOURNAL.dir if _LAST_JOURNAL is not None else None
     evicted = 0
     freed = 0
-    for r in runs:
-        if total - freed <= cap:
-            break
-        if r["dir"] == live:
-            continue
-        _evict_run_dir(r["dir"])
-        evicted += 1
-        freed += r["bytes"]
-        obs_spans.instant("durable.gc_evict", fingerprint=r["fingerprint"],
-                          bytes=r["bytes"], complete=r["complete"])
+    try:
+        for r in runs:
+            if total - freed <= cap:
+                break
+            if r["dir"] == live:
+                continue
+            manifest = os.path.join(r["dir"], MANIFEST)
+            try:
+                now_mtime = os.path.getmtime(manifest)
+            except OSError:
+                now_mtime = None  # already gone — nothing left to tear
+            if now_mtime is not None and now_mtime > r["mtime"] + 1e-6:
+                # freshened since our scan: a replica is using this run
+                obs_metrics.counter_add("durable.gc_skipped_fresh")
+                continue
+            _evict_run_dir(r["dir"])
+            evicted += 1
+            freed += r["bytes"]
+            obs_spans.instant("durable.gc_evict",
+                              fingerprint=r["fingerprint"],
+                              bytes=r["bytes"], complete=r["complete"])
+    finally:
+        _release_gc_lease(lease)
     if evicted:
         obs_metrics.counter_add("durable.gc_runs_evicted", evicted)
         obs_metrics.counter_add("durable.gc_bytes_freed", freed)

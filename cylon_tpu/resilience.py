@@ -247,6 +247,16 @@ _KIND_MESSAGES = {
     "coord_partition": ("UNAVAILABLE: injected control partition at {site} "
                         "(hit {hit}): packet dropped"),
     "coord_slow": "injected slow control verb at {site} (hit {hit})",
+    # tail-tolerance kinds (PR 16): `disk_full` raises OSError(ENOSPC)
+    # at the spill-write probe — the real errno a full shared
+    # CYLON_TPU_DURABLE_DIR produces, so the degraded-mode path is
+    # exercised end to end; `replica_sick` sleeps the probe for
+    # CYLON_TPU_FAULT_DELAY_S and continues — one replica's dispatch
+    # path turns sustainedly slow while staying alive and correct, the
+    # exact straggler hedged requests and health breakers must absorb
+    "disk_full": ("RESOURCE_EXHAUSTED: injected disk full at {site} "
+                  "(hit {hit}): no space left on device"),
+    "replica_sick": "injected sick replica at {site} (hit {hit})",
 }
 
 FAULT_KINDS = tuple(_KIND_MESSAGES)
@@ -434,9 +444,17 @@ def fault_point(site: str) -> None:
 
             time.sleep(max(1.5 * durable.deadline_s(), 0.05))
             return
-        if kind in ("delay", "coord_slow"):
+        if kind in ("delay", "coord_slow", "replica_sick"):
             time.sleep(fault_delay_s())
             return
+        if kind == "disk_full":
+            # the genuine errno, so classification (and any errno-based
+            # handling) is identical to a really-full disk
+            import errno as _errno
+
+            raise OSError(_errno.ENOSPC,
+                          _KIND_MESSAGES[kind].format(site=site,
+                                                      hit=plan.hits[site]))
         raise InjectedFault(site, kind, plan.hits[site])
 
 

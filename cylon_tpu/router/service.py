@@ -101,6 +101,26 @@ def router_max_line() -> int:
     return max(1 << 16, int(config.knob("CYLON_TPU_ROUTER_MAX_LINE_BYTES")))
 
 
+def hedge_floor_ms() -> float:
+    """``CYLON_TPU_ROUTER_HEDGE_MS``: floor (and cold-start value) for
+    the per-fingerprint hedge delay; 0 (default) disables hedging."""
+    return max(0.0, float(config.knob("CYLON_TPU_ROUTER_HEDGE_MS")))
+
+
+def breaker_failures() -> int:
+    """``CYLON_TPU_ROUTER_BREAKER_FAILURES``: consecutive classified
+    failures (or sustained-slow observations) before a replica's health
+    breaker OPENs; 0 disables the breakers entirely."""
+    return max(0, int(config.knob("CYLON_TPU_ROUTER_BREAKER_FAILURES")))
+
+
+def breaker_cooldown_s() -> float:
+    """``CYLON_TPU_ROUTER_BREAKER_COOLDOWN_S``: seconds an OPEN breaker
+    holds before HALF_OPEN admits one real probe request."""
+    return max(0.05,
+               float(config.knob("CYLON_TPU_ROUTER_BREAKER_COOLDOWN_S")))
+
+
 #: consecutive failed proxy verbs against a replica the membership
 #: ledger still believes alive before the router treats it as dead
 #: anyway (the detector will fence it one heartbeat-timeout later; a
@@ -112,7 +132,55 @@ AFFINITY_CAP = 4096
 
 #: the per-replica counter row, single-sourced: every increment site
 #: and the status fallback share this shape
-_PER_REPLICA_ZERO = {"served": 0, "shed": 0, "rerouted_away": 0}
+_PER_REPLICA_ZERO = {"served": 0, "shed": 0, "rerouted_away": 0,
+                     "hedged_away": 0}
+
+#: the journaled built-in serve ops: fingerprint-idempotent and
+#: bit-identical across replicas by the PR-6/14 journal contract, hence
+#: always hedge-safe.  A literal twin of serve.service.OPS on purpose —
+#: importing serve here would drag the jax engine into the router
+#: process, and CY110's host-only guarantee with it.
+HEDGE_SAFE_OPS = frozenset({"join", "join_groupby", "groupby", "sort",
+                            "plan"})
+
+# breaker states — also the `router.breaker_state[replica=N]` gauge
+# values (0 scrapes as healthy, higher is worse)
+BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN = 0, 1, 2
+_BREAKER_NAMES = {BREAKER_CLOSED: "closed",
+                  BREAKER_HALF_OPEN: "half_open",
+                  BREAKER_OPEN: "open"}
+
+#: one replica's breaker row (under ``_router_lock``): transitions are
+#: host-only dict flips — never an RPC or fsync under the lock (CY111)
+_BREAKER_ZERO = {"state": BREAKER_CLOSED, "strikes": 0, "opened_at": 0.0,
+                 "probing": False, "opens": 0, "probes": 0}
+
+#: classified codes that count as a replica-health strike: transient /
+#: infrastructure failures.  Deterministic codes (Invalid, a caller's
+#: oversize payload) are the CALLER's problem and never open a breaker.
+_STRIKE_CODES = (Code.Timeout, Code.Unavailable, Code.UnknownError)
+
+
+class _Attempt:
+    """One placed execution of a routed request (the primary, or its
+    hedge): the replica it landed on, the admitted ticket, and the
+    per-attempt poll/failure bookkeeping."""
+
+    __slots__ = ("rank", "addr", "req_id", "token", "probe", "is_hedge",
+                 "fails", "observed_running", "t_submit", "released")
+
+    def __init__(self, rank: int, addr: Tuple[str, int], req_id: str,
+                 token: str, probe: bool, is_hedge: bool):
+        self.rank = rank
+        self.addr = addr
+        self.req_id = req_id
+        self.token = token
+        self.probe = probe
+        self.is_hedge = is_hedge
+        self.fails = 0
+        self.observed_running = False
+        self.t_submit = time.monotonic()
+        self.released = False
 
 
 def _safe_label(s: str) -> str:
@@ -159,8 +227,14 @@ class QueryRouter(Coordinator):
         self._inflight: Dict[int, int] = {}    # rank -> router-held count
         self._route_ewma_s: Optional[float] = None
         self._route_counts = {"routed": 0, "sheds": 0, "reroutes": 0,
-                              "abandoned": 0}
+                              "abandoned": 0, "hedges_fired": 0,
+                              "hedges_won": 0, "hedges_lost_cancelled": 0}
         self._per_replica: Dict[int, Dict[str, int]] = {}
+        self._breakers: Dict[int, Dict] = {}
+        # per-fingerprint asymmetric-EWMA p99 of observed route latency
+        # (rises fast toward outliers, decays slowly — the PR-13 tail
+        # estimator), bounded like the affinity maps
+        self._key_p99_s: Dict[str, float] = {}
         super().__init__(world, host=host, port=port,
                          heartbeat_timeout_s=heartbeat_timeout_s,
                          log_dir=log_dir)
@@ -205,6 +279,10 @@ class QueryRouter(Coordinator):
                 "capacity": max(1, int(rep.get("capacity", 1) or 1)),
                 "reported_depth": int(t.get("queue_depth", 0) or 0),
                 "headroom": rep.get("hbm_headroom_bytes"),
+                # custom ops the replica declared hedge-safe
+                # (register_op(..., idempotent=True)), heartbeat-shipped
+                "idempotent_ops": frozenset(
+                    str(x) for x in (rep.get("idempotent_ops") or ())),
             }
         obs_metrics.gauge_set("router.replicas_live", len(view))
         return view
@@ -227,13 +305,21 @@ class QueryRouter(Coordinator):
                          retry_after_s=retry_after)
 
     def _place(self, tenant: str, key: str, est_bytes: int,
-               exclude: Set[int]) -> Tuple[int, Tuple[str, int]]:
+               exclude: Set[int]) -> Tuple[int, Tuple[str, int], bool]:
         """Choose AND reserve one replica, or raise a classified
-        `RouteShed`.  Order: cache affinity (a warm replica, when the
-        knob is on), then the tenant's pin, then least live load —
-        affinity never overrides saturation or the HBM-headroom guard,
-        it only breaks ties among replicas that can actually take the
-        request.
+        `RouteShed`; returns ``(rank, addr, probe)`` where ``probe``
+        marks the request as a HALF_OPEN breaker's one live health
+        probe.  Order: cache affinity (a warm replica, when the knob is
+        on), then the tenant's pin, then least live load — affinity
+        never overrides saturation or the HBM-headroom guard, it only
+        breaks ties among replicas that can actually take the request.
+        Health breakers COMPOSE with that order (they never override
+        fencing, affinity or saturation): an OPEN replica is dropped
+        from the candidate set exactly like a fenced one, a HALF_OPEN
+        replica admits one probe request (preferred to the front, so
+        recovery is not starved by a healthy pin), and when the breakers
+        leave nothing the request sheds classified with the shortest
+        remaining cooldown as its retry hint.
 
         The live-load tiebreak adds the router-held in-flight count to
         the (heartbeat-lagged) reported depth, and the chosen replica's
@@ -270,10 +356,30 @@ class QueryRouter(Coordinator):
                 f"({len(fits)} replicas at capacity)",
                 self._retry_after(
                     min(v["reported_depth"] for v in fits.values())))
+        breakers_on = breaker_failures() > 0
         with self._router_lock:
+            now = time.monotonic()
+            admit: Dict[int, bool] = {}   # rank -> is-probe
+            for r in fits:
+                ok, as_probe = (self._breaker_admit_locked(r, now)
+                                if breakers_on else (True, False))
+                if ok:
+                    admit[r] = as_probe
+            if not admit:
+                # every fit replica's breaker is open: classified shed
+                # with the shortest remaining cooldown as the hint
+                cd = breaker_cooldown_s()
+                wait = min((max(0.05, cd - (now - self._breakers[r]
+                                            ["opened_at"]))
+                            for r in fits if r in self._breakers),
+                           default=cd)
+                raise self._shed_route(
+                    tenant, Code.Unavailable,
+                    f"every live replica's health breaker is open "
+                    f"({len(fits)} replicas)", wait)
             order = sorted(
-                fits, key=lambda r: (fits[r]["reported_depth"]
-                                     + self._inflight.get(r, 0), r))
+                admit, key=lambda r: (fits[r]["reported_depth"]
+                                      + self._inflight.get(r, 0), r))
             pin = self._tenant_affinity.get(tenant)
             warm = self._key_affinity.get(key) \
                 if cache_affinity_enabled() else None
@@ -288,9 +394,21 @@ class QueryRouter(Coordinator):
                         < fits[preferred]["capacity"]:
                     order.remove(preferred)
                     order.insert(0, preferred)
+            # a HALF_OPEN replica's probe outranks even the pin: the
+            # fleet gets its capacity back only if one real request
+            # actually lands there
+            probe_rank = next((r for r in order if admit[r]), None)
+            if probe_rank is not None:
+                order.remove(probe_rank)
+                order.insert(0, probe_rank)
             chosen = order[0]
+            probe = admit[chosen]
+            if probe:
+                b = self._breaker_locked(chosen)
+                b["probing"] = True
+                b["probes"] += 1
             self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
-        return chosen, fits[chosen]["addr"]
+        return chosen, fits[chosen]["addr"], probe
 
     def _pin(self, table: Dict, key, rank: int) -> None:
         table.pop(key, None)
@@ -301,6 +419,114 @@ class QueryRouter(Coordinator):
     def _replica_dead(self, rank: int) -> bool:
         with self._lock:
             return rank in self._dead or rank not in self._last_hb
+
+    # -- replica health breakers (host-only transitions; cylint CY111) -----
+
+    def _breaker_locked(self, rank: int) -> Dict:
+        """One replica's breaker row; call holding ``_router_lock``."""
+        return self._breakers.setdefault(rank, dict(_BREAKER_ZERO))
+
+    def _breaker_set_locked(self, b: Dict, rank: int, state: int) -> None:
+        b["state"] = state
+        obs_metrics.gauge_set(f"router.breaker_state[replica={rank}]",
+                              state)
+
+    def _breaker_admit_locked(self, rank: int, now: float
+                              ) -> Tuple[bool, bool]:
+        """``(admit, as_probe)`` for one placement candidate; call
+        holding ``_router_lock``.  OPEN past its cooldown transitions to
+        HALF_OPEN here (the timed probe window opens lazily, on the next
+        placement that wants the replica)."""
+        b = self._breakers.get(rank)
+        if b is None or b["state"] == BREAKER_CLOSED:
+            return True, False
+        if b["state"] == BREAKER_OPEN:
+            if now - b["opened_at"] < breaker_cooldown_s():
+                return False, False
+            self._breaker_set_locked(b, rank, BREAKER_HALF_OPEN)
+            b["probing"] = False
+        # HALF_OPEN: exactly one real request probes at a time
+        if b["probing"]:
+            return False, False
+        return True, True
+
+    def _breaker_outcome(self, rank: int, ok: bool, slow: bool = False,
+                         probe: bool = False) -> None:
+        """Feed one classified outcome into a replica's breaker.  A
+        clean completion resets the strike streak (and re-closes a
+        HALF_OPEN breaker when it was the probe); a failure or a
+        sustained-slow observation strikes, and ``breaker_failures()``
+        consecutive strikes — or any failed probe — OPEN the breaker."""
+        if breaker_failures() <= 0:
+            return
+        now = time.monotonic()
+        with self._router_lock:
+            b = self._breaker_locked(rank)
+            if probe:
+                b["probing"] = False
+            if ok and not slow:
+                b["strikes"] = 0
+                if b["state"] == BREAKER_HALF_OPEN and probe:
+                    self._breaker_set_locked(b, rank, BREAKER_CLOSED)
+                    opened = False
+                else:
+                    return
+            else:
+                b["strikes"] += 1
+                opened = (b["state"] == BREAKER_HALF_OPEN or probe
+                          or b["strikes"] >= breaker_failures())
+                if opened:
+                    b["strikes"] = 0
+                    b["probing"] = False
+                    b["opened_at"] = now
+                    b["opens"] += 1
+                    self._breaker_set_locked(b, rank, BREAKER_OPEN)
+                else:
+                    return
+        # transitions only, outside the lock: one instant per flip
+        obs_spans.instant("router.breaker",
+                          replica=rank,
+                          state=_BREAKER_NAMES[BREAKER_OPEN if opened
+                                               else BREAKER_CLOSED])
+
+    def _breaker_force_open(self, rank: int, why: str) -> None:
+        """Fencing/breaker agreement: a replica the membership ledger
+        fenced (or the proxy path declared unreachable) is OPEN by
+        definition — the two subsystems must never disagree on a dead
+        replica."""
+        if breaker_failures() <= 0:
+            return
+        with self._router_lock:
+            b = self._breaker_locked(rank)
+            if b["state"] == BREAKER_OPEN:
+                return
+            b["strikes"] = 0
+            b["probing"] = False
+            b["opened_at"] = time.monotonic()
+            b["opens"] += 1
+            self._breaker_set_locked(b, rank, BREAKER_OPEN)
+        obs_spans.instant("router.breaker", replica=rank, state="open",
+                          reason=why)
+
+    def _breaker_clear_probe(self, rank: int) -> None:
+        """Release a probe slot without a health verdict (the probe
+        request was shed at admission or never started) so the next
+        request can probe instead of the window staying wedged."""
+        with self._router_lock:
+            b = self._breakers.get(rank)
+            if b is not None:
+                b["probing"] = False
+
+    def _slow_threshold_locked(self) -> float:
+        """Latency past which a completion counts as p99 inflation (a
+        strike): well past the fleet's own route EWMA, with a floor so
+        a cold fleet never strikes on its first compile."""
+        per = self._route_ewma_s
+        return max(0.25, 4.0 * (per if per is not None else 0.25))
+
+    def _is_slow(self, dur: float) -> bool:
+        with self._router_lock:
+            return dur > self._slow_threshold_locked()
 
     # -- the route verb ----------------------------------------------------
 
@@ -374,7 +600,7 @@ class QueryRouter(Coordinator):
                     f"route exceeded its {deadline_s:g}s bound before "
                     f"any replica accepted (tenant {tenant!r})")
             try:
-                rank, addr = self._place(tenant, key, est, exclude)
+                rank, addr, probe = self._place(tenant, key, est, exclude)
             except RouteShed as e:
                 # replicas excluded for SHEDDING make "nothing is left"
                 # the fleet-saturation case: the last replica-level
@@ -396,11 +622,16 @@ class QueryRouter(Coordinator):
                 # Reap the possible orphan by token; trying the next
                 # replica then stays placement, not a re-route.
                 self._note_inflight(rank, -1)
+                self._breaker_outcome(rank, ok=False, probe=probe)
                 self._try_cancel(addr, None, token=token)
                 exclude.add(rank)
                 continue
             if not resp.get("ok"):
                 self._note_inflight(rank, -1)
+                if probe:
+                    # an admission shed says nothing about health —
+                    # release the probe slot, don't judge
+                    self._breaker_clear_probe(rank)
                 c = resp.get("classified")
                 if c is None and resp.get("error"):
                     c = {"msg": str(resp["error"])}
@@ -426,16 +657,15 @@ class QueryRouter(Coordinator):
                 with self._router_lock:
                     self._pin(self._tenant_affinity, tenant, rank)
                     self._pin(self._key_affinity, key, rank)
-            try:
-                done = self._proxy_poll(tenant, rank, addr, req_id,
-                                        deadline)
-            finally:
-                self._note_inflight(rank, -1)
+            primary = _Attempt(rank, addr, req_id, token, probe=probe,
+                               is_hedge=False)
+            done = self._drive(tenant, op, key, primary, deadline,
+                               submit, est, exclude)
             if done is not None:
                 with self._router_lock:
-                    self._pin(self._key_affinity, key, rank)
-                return {**done, "replica": rank, "reroutes": reroutes}
-            # the replica died with the request queued-not-dispatched:
+                    self._pin(self._key_affinity, key, done["replica"])
+                return {**done, "reroutes": reroutes}
+            # every attempt died with the request queued-not-dispatched:
             # re-route it to a survivor — never silently lost
             reroutes += 1
             exclude.add(rank)
@@ -460,94 +690,292 @@ class QueryRouter(Coordinator):
             else:
                 self._inflight.pop(rank, None)
 
-    def _proxy_poll(self, tenant: str, rank: int, addr: Tuple[str, int],
-                    req_id: str, deadline: float) -> Optional[Dict]:
-        """Poll one accepted ticket to a terminal state.  Returns the
-        terminal dict, raises the replica's classified error, or returns
-        None when the replica DIED while the ticket was still queued
-        (the caller re-routes).  A death after the ticket was observed
-        running is the PR-6 abandon-don't-retry contract: classified
-        retryable `Code.Unavailable`, never a silent re-execution.
+    # -- the proxy drive loop (hedged requests live here) ------------------
+
+    def _note_latency(self, key: str, dur: float) -> None:
+        """Fold one observed route latency into the per-fingerprint
+        asymmetric-EWMA p99 (rises fast toward outliers, decays slowly
+        — the PR-13 tail estimator): the hedge delay for the NEXT
+        request of this fingerprint."""
+        with self._router_lock:
+            est = self._key_p99_s.pop(key, None)
+            if est is None:
+                est = dur
+            elif dur > est:
+                est += 0.5 * (dur - est)
+            else:
+                est -= 0.01 * (est - dur)
+            self._key_p99_s[key] = est
+            while len(self._key_p99_s) > AFFINITY_CAP:
+                self._key_p99_s.pop(next(iter(self._key_p99_s)))
+
+    def _hedge_delay_s(self, op: str, key: str,
+                       primary_rank: int) -> Optional[float]:
+        """Seconds after the primary submit before a hedge may fire, or
+        None when this request must never hedge: hedging off (the
+        ``CYLON_TPU_ROUTER_HEDGE_MS`` floor is 0), or a custom op whose
+        registration on the PRIMARY replica did not declare
+        ``idempotent=True`` — a speculative duplicate of a handler with
+        unknown side effects is exactly the bug the opt-in exists to
+        prevent.  The built-in journaled ops are always safe (the
+        PR-6/14 fingerprint-idempotency contract)."""
+        floor = hedge_floor_ms()
+        if floor <= 0:
+            return None
+        if op not in HEDGE_SAFE_OPS:
+            v = self._replica_view().get(primary_rank)
+            if v is None or op not in v["idempotent_ops"]:
+                return None
+        with self._router_lock:
+            est = self._key_p99_s.get(key)
+        return max(floor / 1000.0, est if est is not None else 0.0)
+
+    def _release(self, a: _Attempt) -> None:
+        if not a.released:
+            a.released = True
+            self._note_inflight(a.rank, -1)
+
+    def _try_hedge(self, tenant: str, op: str, key: str, submit: Dict,
+                   est: int, attempts: List[_Attempt], exclude: Set[int],
+                   hedge_exclude: Set[int]) -> Optional[_Attempt]:
+        """Speculatively place the request on a SECOND replica.  Returns
+        the admitted hedge attempt, or None when no eligible replica
+        could take it right now (the caller may try again next tick).
+        Custom ops restrict the target set to replicas whose telemetry
+        declares the op idempotent — a hedge lands only where the
+        registration promised safety."""
+        avoid = exclude | hedge_exclude | {a.rank for a in attempts}
+        if op not in HEDGE_SAFE_OPS:
+            view = self._replica_view()
+            avoid |= {r for r, v in view.items()
+                      if op not in v["idempotent_ops"]}
+        try:
+            rank, addr, probe = self._place(tenant, key, est, avoid)
+        except RouteShed:
+            return None
+        sub = dict(submit)
+        sub["token"] = token = uuid.uuid4().hex
+        try:
+            resp = control.request(addr, sub, timeout=rpc_timeout_s(),
+                                   max_line=self.SERVER_MAX_LINE)
+        except OSError:
+            self._note_inflight(rank, -1)
+            self._breaker_outcome(rank, ok=False, probe=probe)
+            self._try_cancel(addr, None, token=token)
+            hedge_exclude.add(rank)
+            return None
+        if not resp.get("ok"):
+            # a shed (or any refusal) of the SPECULATIVE copy never
+            # fails or sheds the request — the primary is still running
+            self._note_inflight(rank, -1)
+            if probe:
+                self._breaker_clear_probe(rank)
+            hedge_exclude.add(rank)
+            return None
+        return _Attempt(rank, addr, str(resp.get("req_id")), token,
+                        probe=probe, is_hedge=True)
+
+    def _drive(self, tenant: str, op: str, key: str, primary: _Attempt,
+               deadline: float, submit: Dict, est: int,
+               exclude: Set[int]) -> Optional[Dict]:
+        """Drive one admitted request to a terminal state, hedging onto
+        a second replica when the primary outlives the fingerprint's
+        hedge delay.  Returns the winner's terminal dict (with
+        ``replica``/``hedged``/``hedge_won``), raises the classified
+        error, or returns None when EVERY attempt died with the request
+        queued-not-dispatched (the caller re-routes).
+
+        First terminal ticket wins; losers are proxy-cancelled (the
+        serve layer stops them at a pass boundary) and their replicas
+        take a sustained-slow breaker strike — losing your own request
+        to a hedge IS the p99-inflation signal.  A death after a ticket
+        was observed ``running`` abandons that ATTEMPT; the request
+        itself survives as long as another attempt lives (the hedge
+        exists only for idempotent ops, so the duplicate execution the
+        abandon contract bans was already declared safe).
 
         Two contracts the wire imposes: (a) the queued-vs-running
-        distinction is observed at POLLING granularity — a replica dying
-        before any poll saw ``running`` re-routes, which is exact for
-        the journaled built-in ops (the survivor consumes the dead
-        replica's journaled passes bit-identically) and the reason
-        ``register_op`` handlers must be idempotent; (b) a terminal
-        reply read here is ACKNOWLEDGED back to the replica — the
-        ticket survives a reply lost on the wire (the retried poll
-        regenerates it) and drops only on the ack."""
-        fails = 0
-        observed_running = False
-        poll = {"cmd": "poll", "req_id": req_id}
-        while True:
-            if self._replica_dead(rank):
-                return self._on_replica_death(tenant, rank, addr, req_id,
-                                              observed_running)
-            if time.monotonic() >= deadline:
-                self._try_cancel(addr, req_id)
-                raise CylonError(
-                    Code.Timeout,
-                    f"routed request exceeded its deadline on replica "
-                    f"{rank} (tenant {tenant!r}); proxied ticket "
-                    f"cancelled at the next pass boundary")
-            try:
-                resp = control.request(addr, poll,
-                                       timeout=rpc_timeout_s(),
-                                       max_line=self.SERVER_MAX_LINE)
-            except control.ProtocolError as e:
-                # DETERMINISTIC, not a death: the reply exceeds the
-                # data-plane line cap — every retry would fail the same
-                # way, and counting it toward MAX_PROXY_FAILURES would
-                # declare a healthy replica dead and re-route into the
-                # same wall.  Same classification the request path
-                # gives oversize, naming the knob; the terminal ticket
-                # is acked away so the replica doesn't hold it forever.
-                self._try_ack(addr, req_id)
-                raise CylonError(
-                    Code.SerializationError,
-                    f"replica {rank}'s reply exceeds the "
-                    f"{self.SERVER_MAX_LINE}-byte "
-                    f"CYLON_TPU_ROUTER_MAX_LINE_BYTES wire cap (tenant "
-                    f"{tenant!r}); raise the knob (router AND replicas) "
-                    f"or ship less data per request") from e
-            except OSError:
-                fails += 1
-                if fails >= MAX_PROXY_FAILURES \
-                        or self._replica_dead(rank):
-                    return self._on_replica_death(
-                        tenant, rank, addr, req_id, observed_running)
-                time.sleep(poll_interval_s())
-                continue
-            fails = 0
-            state = resp.get("state")
-            if not resp.get("ok"):
-                if state == "unknown":
-                    # the replica lost track of an ADMITTED ticket
-                    # (TICKET_CAP eviction, a data-plane restart): the
-                    # replica's failure, not the caller's — classified
-                    # RETRYABLE, never the replica's unknown-req_id
-                    # Code.Invalid (which would read as a caller bug)
+        distinction is observed at POLLING granularity — exact for the
+        journaled built-in ops (a survivor consumes the dead replica's
+        journaled passes bit-identically); (b) a terminal reply read
+        here is ACKNOWLEDGED back to the replica — the ticket survives
+        a reply lost on the wire and drops only on the ack."""
+        attempts: List[_Attempt] = [primary]
+        hedge_exclude: Set[int] = set()
+        hedge_fired = False
+        hedge_tries = 0
+        delay = self._hedge_delay_s(op, key, primary.rank)
+        hedge_at = None if delay is None else primary.t_submit + delay
+        try:
+            while True:
+                now = time.monotonic()
+                if now >= deadline:
+                    for a in attempts:
+                        self._try_cancel(a.addr, a.req_id)
+                        self._breaker_outcome(a.rank, ok=False,
+                                              probe=a.probe)
                     raise CylonError(
-                        Code.Unavailable,
-                        f"replica {rank} lost track of an admitted "
-                        f"request (ticket evicted or replica restarted; "
-                        f"tenant {tenant!r}) — resubmit to replay "
-                        f"journaled passes",
-                        retry_after_s=self._retry_after(0))
-                raise wire.classified_error(resp.get("classified"))
-            if state == "done":
-                self._try_ack(addr, req_id)
-                return {"result": resp.get("result"),
-                        "stats": resp.get("stats"),
-                        "cache_hit": bool(resp.get("cache_hit"))}
-            if state in ("failed", "cancelled", "shed"):
-                self._try_ack(addr, req_id)
-                raise wire.classified_error(resp.get("classified"))
-            if state == "running":
-                observed_running = True
-            time.sleep(poll_interval_s())
+                        Code.Timeout,
+                        f"routed request exceeded its deadline on "
+                        f"replica(s) "
+                        f"{sorted(a.rank for a in attempts)} (tenant "
+                        f"{tenant!r}); proxied ticket(s) cancelled at "
+                        f"the next pass boundary")
+                if hedge_at is not None and not hedge_fired \
+                        and now >= hedge_at and len(attempts) == 1:
+                    hedge_tries += 1
+                    if hedge_tries > 3:
+                        hedge_at = None  # stop shopping a hedge around
+                    else:
+                        a2 = self._try_hedge(tenant, op, key, submit,
+                                             est, attempts, exclude,
+                                             hedge_exclude)
+                        if a2 is not None:
+                            attempts.append(a2)
+                            hedge_fired = True
+                            with self._router_lock:
+                                self._route_counts["hedges_fired"] += 1
+                            obs_metrics.counter_add("router.hedges_fired")
+                            obs_spans.instant(
+                                "router.hedge_fired", tenant=tenant,
+                                op=op, primary=primary.rank,
+                                hedge=a2.rank, delay_s=round(delay, 4))
+                for a in list(attempts):
+                    kind, val = self._poll_attempt_once(tenant, a)
+                    if kind == "done":
+                        return self._settle(tenant, key, attempts, a,
+                                            val, hedge_fired)
+                    if kind == "error":
+                        if isinstance(val, CylonError) \
+                                and val.code in _STRIKE_CODES:
+                            self._breaker_outcome(a.rank, ok=False,
+                                                  probe=a.probe)
+                        elif a.probe:
+                            self._breaker_clear_probe(a.rank)
+                        if len(attempts) > 1:
+                            # the OTHER attempt may still win: a
+                            # per-replica transient must not fail a
+                            # request whose hedge is healthy
+                            attempts.remove(a)
+                            self._release(a)
+                            exclude.add(a.rank)
+                            continue
+                        raise val
+                    if kind == "dead":
+                        self._breaker_force_open(
+                            a.rank, "unreachable from the proxy path")
+                        if len(attempts) > 1:
+                            self._try_cancel(a.addr, a.req_id)
+                            attempts.remove(a)
+                            self._release(a)
+                            exclude.add(a.rank)
+                            continue
+                        # sole attempt: the exact PR-14 death contract
+                        # (None re-routes queued work; observed-running
+                        # raises the abandon-don't-retry classified)
+                        return self._on_replica_death(
+                            tenant, a.rank, a.addr, a.req_id,
+                            a.observed_running)
+                time.sleep(poll_interval_s())
+        finally:
+            for a in attempts:
+                self._release(a)
+
+    def _settle(self, tenant: str, key: str, attempts: List[_Attempt],
+                winner: _Attempt, done: Dict, hedge_fired: bool) -> Dict:
+        """First terminal ticket wins: cancel every loser (the serve
+        layer stops it at a pass boundary), strike its replica's breaker
+        (losing to a hedge is the latency-inflation signal), and feed
+        the winner's latency into the fingerprint's hedge clock."""
+        dur = time.monotonic() - winner.t_submit
+        self._note_latency(key, dur)
+        for o in attempts:
+            if o is winner:
+                continue
+            self._try_cancel(o.addr, o.req_id)
+            self._release(o)
+            self._breaker_outcome(o.rank, ok=False, slow=True,
+                                  probe=o.probe)
+            with self._router_lock:
+                self._route_counts["hedges_lost_cancelled"] += 1
+                self._per_locked(o.rank)["hedged_away"] += 1
+            obs_metrics.counter_add("router.hedges_lost_cancelled")
+            obs_spans.instant("router.hedge_lost", tenant=tenant,
+                              replica=o.rank, winner=winner.rank)
+        self._breaker_outcome(winner.rank, ok=True,
+                              slow=self._is_slow(dur),
+                              probe=winner.probe)
+        if winner.is_hedge:
+            with self._router_lock:
+                self._route_counts["hedges_won"] += 1
+            obs_metrics.counter_add("router.hedges_won")
+        return {**done, "replica": winner.rank,
+                "hedged": 1 if hedge_fired else 0,
+                "hedge_won": winner.is_hedge}
+
+    def _poll_attempt_once(self, tenant: str,
+                           a: _Attempt) -> Tuple[str, Optional[object]]:
+        """One poll round for one attempt: ``("pending", None)``,
+        ``("done", terminal-dict)``, ``("error", CylonError)`` (already
+        acked when terminal), or ``("dead", None)`` — the replica is
+        fenced/unreachable and the caller decides what that means for
+        the request (re-route, abandon, or drop-the-attempt)."""
+        if self._replica_dead(a.rank):
+            return "dead", None
+        try:
+            resp = control.request(a.addr,
+                                   {"cmd": "poll", "req_id": a.req_id},
+                                   timeout=rpc_timeout_s(),
+                                   max_line=self.SERVER_MAX_LINE)
+        except control.ProtocolError as e:
+            # DETERMINISTIC, not a death: the reply exceeds the
+            # data-plane line cap — every retry would fail the same
+            # way, and counting it toward MAX_PROXY_FAILURES would
+            # declare a healthy replica dead and re-route into the
+            # same wall.  Same classification the request path gives
+            # oversize, naming the knob; the terminal ticket is acked
+            # away so the replica doesn't hold it forever.
+            self._try_ack(a.addr, a.req_id)
+            return "error", CylonError(
+                Code.SerializationError,
+                f"replica {a.rank}'s reply exceeds the "
+                f"{self.SERVER_MAX_LINE}-byte "
+                f"CYLON_TPU_ROUTER_MAX_LINE_BYTES wire cap (tenant "
+                f"{tenant!r}); raise the knob (router AND replicas) "
+                f"or ship less data per request")
+        except OSError:
+            a.fails += 1
+            if a.fails >= MAX_PROXY_FAILURES or self._replica_dead(a.rank):
+                return "dead", None
+            return "pending", None
+        a.fails = 0
+        state = resp.get("state")
+        if not resp.get("ok"):
+            if state == "unknown":
+                # the replica lost track of an ADMITTED ticket
+                # (TICKET_CAP eviction, a data-plane restart): the
+                # replica's failure, not the caller's — classified
+                # RETRYABLE, never the replica's unknown-req_id
+                # Code.Invalid (which would read as a caller bug)
+                return "error", CylonError(
+                    Code.Unavailable,
+                    f"replica {a.rank} lost track of an admitted "
+                    f"request (ticket evicted or replica restarted; "
+                    f"tenant {tenant!r}) — resubmit to replay "
+                    f"journaled passes",
+                    retry_after_s=self._retry_after(0))
+            return "error", wire.classified_error(resp.get("classified"))
+        if state == "done":
+            self._try_ack(a.addr, a.req_id)
+            return "done", {"result": resp.get("result"),
+                            "stats": resp.get("stats"),
+                            "cache_hit": bool(resp.get("cache_hit"))}
+        if state in ("failed", "cancelled", "shed"):
+            self._try_ack(a.addr, a.req_id)
+            return "error", wire.classified_error(resp.get("classified"))
+        if state == "running":
+            a.observed_running = True
+        return "pending", None
 
     def _on_replica_death(self, tenant: str, rank: int,
                           addr: Tuple[str, int], req_id: str,
@@ -611,17 +1039,29 @@ class QueryRouter(Coordinator):
     def router_status(self) -> Dict:
         """The routing table the ``status`` verb ships and
         ``tools/fleet_status.py --replicas`` renders: per-replica
-        capacity/depth/headroom plus served/shed/re-route counters and
-        the current affinity pins."""
+        capacity/depth/headroom plus served/shed/re-route/hedge
+        counters, breaker state, and the current affinity pins.
+        ``breakers`` lists EVERY known breaker (dead replicas included,
+        forced open first — fencing and breaker state must never
+        disagree on a dead replica), while ``replicas`` rows cover the
+        live serving set."""
         view = self._replica_view()
+        with self._lock:
+            fenced = set(self._dead)
+        for r in fenced:
+            self._breaker_force_open(r, "fenced by the membership "
+                                        "detector")
         with self._router_lock:
             counts = dict(self._route_counts)
             per = {r: dict(c) for r, c in sorted(self._per_replica.items())}
             tenants = dict(self._tenant_affinity)
             keys = len(self._key_affinity)
             inflight = dict(self._inflight)
+            breakers = {r: dict(b) for r, b in sorted(self._breakers
+                                                      .items())}
         replicas = {}
         for rank, v in sorted(view.items()):
+            b = breakers.get(rank, _BREAKER_ZERO)
             replicas[str(rank)] = {
                 "addr": f"{v['addr'][0]}:{v['addr'][1]}",
                 "capacity": v["capacity"],
@@ -629,12 +1069,19 @@ class QueryRouter(Coordinator):
                 "router_inflight": inflight.get(rank, 0),
                 "hbm_headroom_bytes": v["headroom"],
                 **per.get(rank, _PER_REPLICA_ZERO),
+                "breaker": _BREAKER_NAMES[b["state"]],
+                "breaker_opens": b["opens"],
+                "breaker_probes": b["probes"],
                 "tenants_pinned": sorted(
                     t for t, r in tenants.items() if r == rank),
             }
         return {"replicas": replicas, "replicas_live": len(view),
                 "cache_affinity": cache_affinity_enabled(),
-                "key_pins": keys, **counts}
+                "key_pins": keys,
+                "hedging": hedge_floor_ms() > 0,
+                "breakers": {str(r): _BREAKER_NAMES[b["state"]]
+                             for r, b in breakers.items()},
+                **counts}
 
 
 # ---------------------------------------------------------------------------
@@ -747,7 +1194,9 @@ class RouterClient:
         stats = dict(resp.get("stats") or {})
         stats["router"] = {"replica": resp.get("replica"),
                            "reroutes": resp.get("reroutes", 0),
-                           "cache_hit": bool(resp.get("cache_hit"))}
+                           "cache_hit": bool(resp.get("cache_hit")),
+                           "hedged": int(resp.get("hedged", 0) or 0),
+                           "hedge_won": bool(resp.get("hedge_won"))}
         return result, stats
 
     def status(self, timeout_s: float = 5.0) -> Dict:

@@ -158,14 +158,31 @@ _RUNNERS = {
 }
 
 
-def register_op(op: str, runner) -> None:
+#: custom ops whose registration declared ``idempotent=True`` — the
+#: router's hedging safety gate (built-in OPS are fingerprint-idempotent
+#: by the PR-6 journal contract and need no declaration)
+_IDEMPOTENT_OPS: set = set()
+
+
+def register_op(op: str, runner, *, idempotent: bool = False) -> None:
     """Register a custom serve op: ``runner(*args, ctx=, pass_guard=,
     **kwargs) -> (result, stats)``.  The runner executes on the
     scheduler thread under the request's trace context, with the same
     cancellation/deadline guard every built-in op gets — the extension
     point the cross-rank tracing smoke uses to drive an elastic gang
-    from one serve request."""
-    _RUNNERS[str(op)] = runner
+    from one serve request.
+
+    ``idempotent=True`` declares that re-running the op with the same
+    arguments is side-effect-safe and bit-identical — the opt-in that
+    lets the fleet router HEDGE requests for this op onto a second
+    replica (a hedge never fires for an undeclared custom op: the
+    router cannot know a handler's side effects)."""
+    op = str(op)
+    _RUNNERS[op] = runner
+    if idempotent:
+        _IDEMPOTENT_OPS.add(op)
+    else:
+        _IDEMPOTENT_OPS.discard(op)
 
 QUEUED = "queued"
 RUNNING = "running"
@@ -342,6 +359,7 @@ class QueryService:
         self._closed = False
         self._ewma_s: Optional[float] = None
         self._runners: Dict[str, object] = {}  # instance op overrides
+        self._idempotent_ops: set = set()      # declared-hedgeable ops
         self._pending_flight: List[dict] = []  # staged shed dumps
         self._counts = {"admitted": 0, "shed": 0, "completed": 0,
                         "failed": 0, "cancelled": 0, "cache_hits": 0,
@@ -625,14 +643,30 @@ class QueryService:
             return max(0.0, float(b.deadline_s))
         return default_deadline_s()
 
-    def register_op(self, op: str, runner) -> "QueryService":
+    def register_op(self, op: str, runner, *,
+                    idempotent: bool = False) -> "QueryService":
         """Instance-scoped op registration: like the module-level
         :func:`register_op` but visible only to THIS service — two
         replicas in one process (the router tests' rendering) can serve
-        the same op name through different runners."""
+        the same op name through different runners.  ``idempotent=True``
+        declares the op hedge-safe (see the module-level docstring)."""
+        op = str(op)
         with self._lock:
-            self._runners[str(op)] = runner
+            self._runners[op] = runner
+            if idempotent:
+                self._idempotent_ops.add(op)
+            else:
+                self._idempotent_ops.discard(op)
         return self
+
+    def idempotent_ops(self) -> List[str]:
+        """Custom ops this service may be hedged on: every registration
+        (module or instance scope) that declared ``idempotent=True``.
+        Shipped to the router via replica telemetry — placement-time
+        ground truth, so a hedge can never land on a replica whose
+        registration made no safety promise."""
+        with self._lock:
+            return sorted(_IDEMPOTENT_OPS | self._idempotent_ops)
 
     def _run_ticket(self, ticket: Ticket) -> None:
         tenant = ticket.tenant

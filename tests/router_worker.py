@@ -67,7 +67,9 @@ def main() -> int:
         return chunked_join(left, right, ctx=ctx, pass_guard=pass_guard,
                             **kw)
 
-    svc.register_op("kjoin", kjoin)
+    # idempotent=True: kjoin is a pure journaled join, so the chaos
+    # smoke's hedges are allowed to speculate it onto a second replica
+    svc.register_op("kjoin", kjoin, idempotent=True)
     rep = ReplicaServer(svc)
     rep.attach(agent)
     print(f"router_worker r{rank}: serving at "

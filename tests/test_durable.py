@@ -581,3 +581,234 @@ def test_quarantine_disabled_by_default(rng):
                 _run(left, right, passes=2)
     assert ei.value.code == Code.ExecutionError
     assert "retries exhausted" in ei.value.msg
+
+
+# ---------------------------------------------------------------------------
+# degraded mode: a full shared disk loses durability, never the answer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_disk_full_fault_degrades_run_not_failed(rng, tmp_path):
+    """An injected ENOSPC at the spill write (`disk_full` — the real
+    errno a full shared CYLON_TPU_DURABLE_DIR produces) degrades the run
+    to journal-off execution: the answer is still served bit-identical,
+    classified `ResourceExhausted` in the trace and counted under
+    ``durable.degraded`` — never an UnknownError, never a failed pass."""
+    left, right = _join_inputs(rng)
+    base, _ = _run(left, right, passes=3)
+    obs_spans.reset()
+    obs_metrics.reset()
+    try:
+        with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path),
+                             CYLON_TPU_TRACE="1"):
+            with resilience.fault_plan("journal_spill@1=disk_full") as plan:
+                res, _ = _run(left, right, passes=3)
+        assert plan.fired == [("journal_spill", "disk_full", 1)]
+        _assert_bit_identical(res, base)
+        assert obs_metrics.counter_value("durable.degraded") == 1
+        # disk pressure is NOT an anonymous IO bug: the operator signal
+        # stays separable
+        assert obs_metrics.counter_value("durable.spill_errors") == 0
+        assert obs_metrics.counter_value("durable.passes_journaled") == 0
+        degraded = [e for e in obs_spans.events()
+                    if e.name == "durable.degraded"]
+        assert [e.attrs["code"] for e in degraded] == ["ResourceExhausted"]
+    finally:
+        obs_spans.reset()
+        obs_metrics.reset()
+
+
+def test_quota_budget_degrades_to_journal_off(rng, tmp_path):
+    """CYLON_TPU_DURABLE_QUOTA_BYTES refuses the spill UP FRONT (no
+    ENOSPC needed): the run completes journal-off, counted once under
+    ``durable.degraded``, and nothing lands in the shared root."""
+    left, right = _join_inputs(rng)
+    base, _ = _run(left, right, passes=3)
+    obs_metrics.reset()
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path),
+                         CYLON_TPU_DURABLE_QUOTA_BYTES="1"):
+        res, _ = _run(left, right, passes=3)
+    _assert_bit_identical(res, base)
+    assert obs_metrics.counter_value("durable.degraded") == 1
+    assert obs_metrics.counter_value("durable.passes_journaled") == 0
+    assert all(not r["complete"] for r in durable.scan_runs(str(tmp_path)))
+    obs_metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe shared-journal GC: the advisory lease + LRU-clock re-read
+# ---------------------------------------------------------------------------
+
+def _journal_runs(tmp_path, rng, k=3, passes=2):
+    """``k`` distinct journaled runs in the shared root; returns
+    [(left, right, oracle)] so callers can replay any of them."""
+    runs = []
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        for _ in range(k):
+            left, right = _join_inputs(rng, n=800)
+            base, _ = _run(left, right, passes=passes)
+            runs.append((left, right, base))
+    return runs
+
+
+def _stagger_lru(inv):
+    """Deterministic LRU order: re-stamp manifest mtimes 10s apart in
+    scan order (filesystem timestamps of back-to-back runs can tie)."""
+    now = time.time()
+    for i, r in enumerate(inv):
+        ts = now - 30 + 10 * i
+        os.utime(os.path.join(r["dir"], durable.MANIFEST), (ts, ts))
+
+
+def test_gc_lease_blocks_second_collector_and_breaks_stale(tmp_path, rng):
+    """Cross-process GC discipline, rendered in-process: a live GC_LOCK
+    lease younger than the TTL makes a second collector back off
+    (counted, nothing touched); a stale lease (crashed holder) is broken
+    and eviction proceeds LRU-first, releasing the lock after."""
+    _journal_runs(tmp_path, rng, k=3)
+    inv = durable.scan_runs(str(tmp_path))
+    assert len(inv) == 3
+    _stagger_lru(inv)
+    inv = durable.scan_runs(str(tmp_path))
+    total = sum(r["bytes"] for r in inv)
+    obs_metrics.reset()
+    lease = durable._acquire_gc_lease(str(tmp_path))
+    assert lease is not None
+    try:
+        assert durable.gc_journal(str(tmp_path), cap=total - 1) == (0, 0)
+        assert obs_metrics.counter_value("durable.gc_lease_busy") == 1
+        assert len(durable.scan_runs(str(tmp_path))) == 3
+    finally:
+        durable._release_gc_lease(lease)
+    # a crashed holder's lease: older than the TTL, broken atomically
+    lease = durable._acquire_gc_lease(str(tmp_path))
+    old = time.time() - 2 * durable._GC_LEASE_TTL_S
+    os.utime(lease, (old, old))
+    evicted, freed = durable.gc_journal(str(tmp_path), cap=total - 1)
+    assert evicted == 1 and freed > 0
+    survivors = {r["fingerprint"] for r in durable.scan_runs(str(tmp_path))}
+    assert inv[0]["fingerprint"] not in survivors  # the LRU victim went
+    assert inv[1]["fingerprint"] in survivors
+    assert inv[2]["fingerprint"] in survivors
+    assert not os.path.exists(os.path.join(str(tmp_path), durable.GC_LOCK))
+    obs_metrics.reset()
+
+
+def test_gc_rereads_lru_clock_before_eviction(tmp_path, rng, monkeypatch):
+    """The scan->evict window: a replica replaying the LRU victim
+    freshens its manifest AFTER our inventory scan — the per-victim
+    re-read under the lease spares it this round and the next-LRU run
+    is evicted instead (never a half-evicted run under a reader)."""
+    _journal_runs(tmp_path, rng, k=3)
+    _stagger_lru(durable.scan_runs(str(tmp_path)))
+    inv = durable.scan_runs(str(tmp_path))
+    victim = inv[0]
+    total = sum(r["bytes"] for r in inv)
+    orig = durable._acquire_gc_lease
+
+    def freshen_then_acquire(root):
+        # the racing replica replays the victim exactly between
+        # gc_journal's scan and its lease acquisition
+        os.utime(os.path.join(victim["dir"], durable.MANIFEST))
+        return orig(root)
+
+    monkeypatch.setattr(durable, "_acquire_gc_lease", freshen_then_acquire)
+    obs_metrics.reset()
+    evicted, _ = durable.gc_journal(str(tmp_path), cap=total - 1)
+    assert evicted == 1
+    assert obs_metrics.counter_value("durable.gc_skipped_fresh") == 1
+    survivors = {r["fingerprint"] for r in durable.scan_runs(str(tmp_path))}
+    assert victim["fingerprint"] in survivors      # freshened -> spared
+    assert inv[1]["fingerprint"] not in survivors  # next-LRU went instead
+    obs_metrics.reset()
+
+
+_GC_WORKER_SRC = """\
+import sys
+from cylon_tpu import durable
+ev, fr = durable.gc_journal(sys.argv[1], cap=int(sys.argv[2]))
+print(ev, fr)
+"""
+
+
+def test_concurrent_cross_process_gc_never_leaves_torn_run(tmp_path, rng):
+    """Two real processes GC the shared root at once under the advisory
+    lease: no collector crashes, the lock file is released, and EVERY
+    fingerprint still replays bit-identical afterwards — evicted runs
+    re-execute, surviving runs load, a torn run is never accepted."""
+    runs = _journal_runs(tmp_path, rng, k=3)
+    inv = durable.scan_runs(str(tmp_path))
+    _stagger_lru(inv)
+    smallest = min(r["bytes"] for r in inv)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("CYLON_TPU_DURABLE_DIR", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _GC_WORKER_SRC, str(tmp_path),
+         str(smallest)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for _ in range(2)]
+    outs = [p.communicate(timeout=300) for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    evicted = sum(int(out.split()[0]) for out, _ in outs)
+    assert evicted >= 1
+    assert not os.path.exists(os.path.join(str(tmp_path), durable.GC_LOCK))
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        for left, right, base in runs:
+            res, _ = _run(left, right, passes=2)
+            _assert_bit_identical(res, base)
+
+
+_REPLAY_WORKER_SRC = """\
+import os, sys, time
+root, fp = sys.argv[1], sys.argv[2]
+os.environ["CYLON_TPU_DURABLE_DIR"] = root
+from cylon_tpu import durable
+durable._FRESHEN_MIN_S = 0.0
+j = durable.open_run(fp, "join_groupby")
+assert j is not None, "journal did not open"
+# _open freshened the manifest once; re-age it so this check can only
+# pass if LOAD-time freshening (the PR-16 LRU-clock fix) works
+old = time.time() - 3600
+os.utime(os.path.join(j.dir, durable.MANIFEST), (old, old))
+j._freshened_at = 0.0
+keys = sorted(j._passes)
+assert keys, "journal has no passes to replay"
+assert j.load_pass(*keys[0]) is not None, "journaled pass failed to load"
+print("replayed", len(keys))
+"""
+
+
+def test_replaying_process_freshens_gc_lru_clock(tmp_path, rng, monkeypatch):
+    """The LRU-clock fix, cross-process: a second process that only
+    REPLAYS a run (load_pass, zero writes) advances the manifest mtime,
+    so a shared-root GC under pressure evicts the cold run — never the
+    one being actively replayed."""
+    _journal_runs(tmp_path, rng, k=2)
+    inv = durable.scan_runs(str(tmp_path))
+    assert len(inv) == 2
+    # age BOTH runs deep into the past: only the fix can save either
+    old = time.time() - 3600
+    for r in inv:
+        os.utime(os.path.join(r["dir"], durable.MANIFEST), (old, old))
+    cold, hot = inv[0]["fingerprint"], inv[1]["fingerprint"]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("CYLON_TPU_DURABLE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _REPLAY_WORKER_SRC, str(tmp_path), hot],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "replayed" in proc.stdout
+    inv2 = {r["fingerprint"]: r for r in durable.scan_runs(str(tmp_path))}
+    assert inv2[hot]["mtime"] > old + 1800, \
+        "load_pass in the replaying process never freshened the LRU clock"
+    assert inv2[cold]["mtime"] < old + 1800
+    # make the eviction choice purely clock-driven (this process still
+    # holds the hot run as its own live journal)
+    monkeypatch.setattr(durable, "_LAST_JOURNAL", None)
+    total = sum(r["bytes"] for r in inv2.values())
+    evicted, _ = durable.gc_journal(str(tmp_path), cap=total - 1)
+    assert evicted == 1
+    survivors = {r["fingerprint"] for r in durable.scan_runs(str(tmp_path))}
+    assert hot in survivors and cold not in survivors

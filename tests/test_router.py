@@ -24,7 +24,7 @@ import time
 import numpy as np
 import pytest
 
-from cylon_tpu import config, elastic
+from cylon_tpu import config, durable, elastic, resilience
 from cylon_tpu.router import replica as replica_mod
 from cylon_tpu.exec import chunked_join
 from cylon_tpu.obs import metrics as obs_metrics
@@ -1046,3 +1046,234 @@ def test_fleet_status_replicas_json_rc_parity(fleet, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert json_mod.loads(out) is None
+
+
+# ---------------------------------------------------------------------------
+# tail tolerance: hedged requests + replica health breakers
+# ---------------------------------------------------------------------------
+
+def _sick_join(rank):
+    """Passthrough join op behind a per-rank fault site: the seeded
+    ``replica_sick`` kind stalls ONE replica's dispatch path while the
+    handler stays alive and correct — the straggler shape hedging must
+    absorb."""
+    def run(left, right, *, ctx=None, pass_guard=None, **kw):
+        resilience.fault_point(f"hedge.pass.r{rank}")
+        if pass_guard is not None:
+            pass_guard()  # a cancelled loser stops HERE, pre-execution
+        return chunked_join(left, right, ctx=ctx, pass_guard=pass_guard,
+                            **kw)
+    return run
+
+
+def _wait_hedge_safe(fleet, op, ranks=(0, 1)):
+    """Block until every rank's heartbeat telemetry lists ``op`` as
+    idempotent — registration happened after the agents' first beat."""
+    deadline = time.monotonic() + WAIT_S
+    while time.monotonic() < deadline:
+        view = fleet.router._replica_view()
+        if all(r in view and op in view[r]["idempotent_ops"]
+               for r in ranks):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"{op!r} never turned hedge-safe in telemetry")
+
+
+def test_hedge_beats_sick_replica_bit_identical(fleet, tmp_path):
+    """The acceptance shape: replica 0 turns sick (a seeded 3s dispatch
+    stall), hedging is on — the routed request completes well under the
+    stall via a speculative second placement, bit-identical to the
+    oracle, with exactly one hedge fired, the loser proxy-cancelled at
+    a pass boundary, and zero duplicate side effects (only the winner's
+    run reaches the shared journal)."""
+    left, right = _inputs(70)
+    base, _ = chunked_join(left, right, on="k", passes=2, mode="hash")
+    for r in (0, 1):
+        fleet.svcs[r].register_op("sjoin", _sick_join(r), idempotent=True)
+    _wait_hedge_safe(fleet, "sjoin")
+    obs_metrics.reset()
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path),
+                         CYLON_TPU_ROUTER_HEDGE_MS="100",
+                         CYLON_TPU_FAULT_DELAY_S="3"):
+        with resilience.fault_plan("hedge.pass.r0@1=replica_sick") as plan:
+            t0 = time.monotonic()
+            res, stats = fleet.client.route(
+                "hedge", "sjoin", left, right, on="k", passes=2,
+                mode="hash", timeout_s=WAIT_S)
+            dur = time.monotonic() - t0
+        st = fleet.client.status()["router"]
+        assert st["hedging"] is True
+    assert plan.fired == [("hedge.pass.r0", "replica_sick", 1)]
+    _assert_bit_identical(res, base)
+    assert dur < 2.5, f"the hedge never beat the 3s stall ({dur:.2f}s)"
+    rt = stats["router"]
+    assert rt["replica"] == 1
+    assert rt["hedged"] == 1 and rt["hedge_won"] is True
+    assert st["hedges_fired"] == 1
+    assert st["hedges_won"] == 1
+    assert st["hedges_lost_cancelled"] == 1
+    assert st["replicas"]["0"]["hedged_away"] == 1
+    assert obs_metrics.counter_value("router.hedges_fired") == 1
+    assert obs_metrics.counter_value("router.hedges_won") == 1
+    assert obs_metrics.counter_value("router.hedges_lost_cancelled") == 1
+    # the loser stops at its next pass boundary: replica 0 records the
+    # cancellation, and the shared journal holds ONLY the winner's run
+    deadline = time.monotonic() + WAIT_S
+    while time.monotonic() < deadline:
+        if fleet.svcs[0].stats()["tenants"]["hedge"]["cancelled"] >= 1:
+            break
+        time.sleep(0.02)
+    assert fleet.svcs[0].stats()["tenants"]["hedge"]["cancelled"] == 1
+    assert fleet.svcs[0].stats()["tenants"]["hedge"]["served"] == 0
+    runs = durable.scan_runs(str(tmp_path))
+    assert len(runs) == 1 and runs[0]["complete"]
+    obs_metrics.reset()
+
+
+def test_non_idempotent_custom_op_never_hedges(fleet):
+    """A custom op registered WITHOUT ``idempotent=True`` must never be
+    speculated: even with an aggressive hedge floor the router waits
+    out the slow primary rather than double-executing a handler with
+    unknown side effects."""
+    calls = []
+
+    def slow_op(*args, ctx=None, pass_guard=None, **kw):
+        calls.append(1)
+        time.sleep(0.5)
+        return {"ok": np.array([1])}, {}
+
+    for r in (0, 1):
+        fleet.svcs[r].register_op("sideeffect", slow_op)
+    with config.knob_env(CYLON_TPU_ROUTER_HEDGE_MS="50"):
+        _, stats = fleet.client.route("t", "sideeffect",
+                                      timeout_s=WAIT_S)
+    assert stats["router"]["hedged"] == 0
+    assert stats["router"]["hedge_won"] is False
+    assert len(calls) == 1  # executed exactly once, fleet-wide
+    st = fleet.client.status()["router"]
+    assert st["hedges_fired"] == 0
+
+
+def test_breaker_opens_after_failures_and_probe_recloses(fleet):
+    """The breaker contract: N consecutive classified failures OPEN a
+    replica's breaker, placement skips it entirely (zero submits reach
+    it while OPEN), and after the cooldown a single real request probes
+    the replica and re-closes the breaker on success."""
+    sick = {"on": True}
+
+    def flaky(*args, ctx=None, pass_guard=None, **kw):
+        if sick["on"]:
+            raise CylonError(Code.UnknownError,
+                             "injected flaky replica handler")
+        return {"ok": np.array([1])}, {}
+
+    def healthy(*args, ctx=None, pass_guard=None, **kw):
+        return {"ok": np.array([2])}, {}
+
+    fleet.svcs[0].register_op("flaky", flaky)
+    fleet.svcs[1].register_op("flaky", healthy)
+    submits = [0]
+    orig_submit = fleet.reps[0]._handle_submit
+
+    def spy_submit(req):
+        submits[0] += 1
+        return orig_submit(req)
+
+    fleet.reps[0]._handle_submit = spy_submit
+    with config.knob_env(CYLON_TPU_ROUTER_BREAKER_FAILURES="2",
+                         CYLON_TPU_ROUTER_BREAKER_COOLDOWN_S="1.5"):
+        for _ in range(2):
+            with pytest.raises(CylonError) as ei:
+                fleet.client.route("brk", "flaky", timeout_s=WAIT_S)
+            assert ei.value.code == Code.UnknownError
+        st = fleet.client.status()["router"]
+        assert st["breakers"]["0"] == "open"
+        assert st["replicas"]["0"]["breaker"] == "open"
+        assert st["replicas"]["0"]["breaker_opens"] == 1
+        # while OPEN, placement never touches replica 0 — despite the
+        # tenant's affinity pin pointing there
+        before = submits[0]
+        for _ in range(3):
+            _, stats = fleet.client.route("brk", "flaky",
+                                          timeout_s=WAIT_S)
+            assert stats["router"]["replica"] == 1
+        assert submits[0] == before
+        # heal, wait out the cooldown: ONE real request probes the
+        # half-open replica and the breaker re-closes
+        sick["on"] = False
+        time.sleep(1.6)
+        _, stats = fleet.client.route("brk", "flaky", timeout_s=WAIT_S)
+        assert stats["router"]["replica"] == 0
+        st = fleet.client.status()["router"]
+        assert st["breakers"]["0"] == "closed"
+        assert st["replicas"]["0"]["breaker"] == "closed"
+        assert st["replicas"]["0"]["breaker_probes"] >= 1
+        assert st["replicas"]["0"]["breaker_opens"] == 1
+
+
+def test_fenced_replica_breaker_forced_open(fleet):
+    """Fencing/breaker agreement: once the membership detector fences a
+    dead replica, the status verb reports its breaker OPEN — the two
+    subsystems must never disagree about a dead replica."""
+    fleet.kill(0)
+    deadline = time.monotonic() + WAIT_S
+    while 0 in fleet.router.view().members \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert 0 not in fleet.router.view().members
+    st = fleet.client.status()["router"]
+    assert st["breakers"]["0"] == "open"
+    assert "0" not in st["replicas"]  # fenced out of the serving set
+    assert st["replicas_live"] == 1
+
+
+def test_breaker_state_gauge_in_openmetrics(fleet):
+    """`router.breaker_state[replica=N]` ships through the metrics verb
+    as a labeled gauge (0 closed / 1 half-open / 2 open)."""
+    from cylon_tpu.obs import openmetrics
+
+    with config.knob_env(CYLON_TPU_ROUTER_BREAKER_FAILURES="1"):
+        fleet.router._breaker_force_open(0, "seeded by the gauge test")
+        resp = elastic.control.request(fleet.router.address,
+                                       {"cmd": "metrics"})
+    assert resp["ok"]
+    doc = openmetrics.parse(resp["openmetrics"])
+    gauge = doc["cylon_tpu_router_breaker_state"]
+    assert gauge["type"] == "gauge"
+    vals = {labels.get("replica"): v for _, labels, v in gauge["samples"]}
+    assert vals.get("0") == 2  # OPEN
+    obs_metrics.reset()
+
+
+def test_fleet_status_renders_breaker_and_hedge_columns(fleet, capsys):
+    """--replicas renders the new hedged/breaker columns and the hedging
+    header, with --json carrying the same fields (rc parity)."""
+    import importlib.util
+    import os
+
+    left, right = _inputs(63, n=300)
+    fleet.client.route("acme", "join", left, right, on="k", passes=1,
+                       mode="hash", timeout_s=WAIT_S)
+    with config.knob_env(CYLON_TPU_ROUTER_BREAKER_FAILURES="1"):
+        fleet.router._breaker_force_open(1, "seeded by the column test")
+    spec = importlib.util.spec_from_file_location(
+        "fleet_status_tail", os.path.join(
+            os.path.dirname(__file__), "..", "tools", "fleet_status.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main([fleet.addr, "--replicas"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "hedging=off" in out
+    assert "hedged" in out and "breaker" in out  # the new columns
+    assert "closed" in out and "open" in out     # per-replica states
+    rc = mod.main([fleet.addr, "--replicas", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    j = json.loads(out)
+    assert j["breakers"]["1"] == "open"
+    assert j["replicas"]["1"]["breaker"] == "open"
+    assert j["replicas"]["0"]["breaker"] == "closed"
+    assert j["replicas"]["0"]["hedged_away"] == 0
+    assert j["hedges_fired"] == 0 and j["hedges_won"] == 0
+    assert j["hedging"] is False

@@ -297,6 +297,60 @@ def test_cache_evict_race_reexecutes_instead_of_torn_serve(tmp_path):
     obs_metrics.reset()
 
 
+_GC_LOOP_SRC = """\
+import sys, time
+from cylon_tpu import durable
+end = time.time() + float(sys.argv[2])
+n = 0
+while time.time() < end:
+    ev, fr = durable.gc_journal(sys.argv[1], cap=1)
+    n += ev
+print("evictions", n)
+"""
+
+
+def test_cache_evict_race_with_cross_process_gc(tmp_path):
+    """The PR-7 evict-race shape against a REAL second process: a
+    replica keeps replaying a journaled fingerprint while another
+    process's GC loop (cap=1: evict everything it may) collects the
+    shared root under the advisory lease.  Every replay must come back
+    bit-identical — a cache hit, or a re-execution of whatever the
+    collector tore out from under it — and the lock file must not
+    leak."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    left, right = _inputs(26)
+    base, _ = chunked_join(left, right, on="k", passes=3, mode="hash")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("CYLON_TPU_DURABLE_DIR", None)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        with QueryService() as svc:
+            svc.submit("t", "join", left, right, on="k", passes=3,
+                       mode="hash").result(timeout=WAIT_S)
+            proc = subprocess.Popen(
+                [sys.executable, "-c", _GC_LOOP_SRC, str(tmp_path), "4"],
+                cwd=repo, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True)
+            try:
+                deadline = time.monotonic() + WAIT_S
+                while time.monotonic() < deadline:
+                    r2, _ = svc.submit(
+                        "t", "join", left, right, on="k", passes=3,
+                        mode="hash").result(timeout=WAIT_S)
+                    _assert_bit_identical(r2, base)
+                    if proc.poll() is not None:
+                        break
+            finally:
+                out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err
+    assert "evictions" in out
+    assert not os.path.exists(os.path.join(str(tmp_path), "GC_LOCK"))
+
+
 # ---------------------------------------------------------------------------
 # per-tenant budgets: deadline + quarantine
 # ---------------------------------------------------------------------------

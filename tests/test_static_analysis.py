@@ -480,6 +480,94 @@ def test_cy110_arrow_ipc_decode_is_a_host_only_barrier(tmp_path):
     assert "device_put" in found[0].msg
 
 
+def test_cy111_rpc_under_placement_lock(tmp_path):
+    found = _scan_router(tmp_path, """\
+        from cylon_tpu.net import control
+
+        class Router:
+            def _settle(self, addr, obj):
+                with self._router_lock:
+                    self._counts["hedges_won"] = 1
+                    control.request(addr, obj)
+        """)
+    assert _rules_at(found) == [("CY111", 5)]
+    assert "request" in found[0].msg
+    assert "_router_lock" in found[0].msg
+
+
+def test_cy111_transitive_rpc_under_membership_lock(tmp_path):
+    # the with body only calls a local helper; the helper does the RPC
+    # — the CY110-style walk must follow the edge
+    found = _scan_router(tmp_path, """\
+        from cylon_tpu.net import control
+
+        def _notify(addr):
+            return control.request(addr, {"cmd": "x"})
+
+        class Router:
+            def _breaker_flip(self, addr):
+                with self._lock:
+                    _notify(addr)
+        """)
+    assert _rules_at(found) == [("CY111", 8)]
+
+
+def test_cy111_blocking_after_lock_release_is_clean(tmp_path):
+    # snapshot under the lock, block after release — the prescribed
+    # shape (and how the breaker/hedge paths are actually written)
+    found = _scan_router(tmp_path, """\
+        from cylon_tpu.net import control
+
+        class Router:
+            def _settle(self, addr, obj):
+                with self._router_lock:
+                    snap = dict(self._counts)
+                return control.request(addr, obj)
+        """)
+    assert found == []
+
+
+def test_cy111_closure_defined_under_lock_runs_later(tmp_path):
+    # a nested def's body executes after the with exits — only calls
+    # LEXICALLY in the with body hold the lock
+    found = _scan_router(tmp_path, """\
+        from cylon_tpu.net import control
+
+        class Router:
+            def _arm(self, addr):
+                with self._router_lock:
+                    def fire():
+                        return control.request(addr, {})
+                    self._pending.append(fire)
+        """)
+    assert found == []
+
+
+def test_cy111_fsync_under_lock_in_durable(tmp_path):
+    dur = ("durable.py", """\
+        import os
+
+        class RunJournal:
+            def _commit(self, fh):
+                with self._lock:
+                    os.fsync(fh.fileno())
+        """)
+    found = _scan_router(tmp_path, "X = 1\n", extra=[dur])
+    assert _rules_at(found) == [("CY111", 5)]
+    assert "fsync" in found[0].msg
+
+
+def test_cy111_only_fires_in_scoped_modules(tmp_path):
+    found = _scan(tmp_path, """\
+        from cylon_tpu.net import control
+
+        def flip(lock, addr):
+            with lock:
+                return control.request(addr, {})
+        """)
+    assert "CY111" not in {f.rule for f in found}
+
+
 def _scan_plan(tmp_path, src, name="executor.py"):
     """CY108 fixtures must live under cylon_tpu/plan/ for the module
     name to resolve into the planner namespace."""

@@ -124,14 +124,19 @@ def render_replicas(st: Dict) -> str:
              f"reroutes={rt.get('reroutes', 0)} "
              f"abandoned={rt.get('abandoned', 0)}  "
              f"cache_affinity={'on' if rt.get('cache_affinity') else 'off'}"
-             f" ({rt.get('key_pins', 0)} fingerprint pin(s))"]
+             f" ({rt.get('key_pins', 0)} fingerprint pin(s))  "
+             f"hedging={'on' if rt.get('hedging') else 'off'} "
+             f"(fired={rt.get('hedges_fired', 0)} "
+             f"won={rt.get('hedges_won', 0)} "
+             f"cancelled={rt.get('hedges_lost_cancelled', 0)})"]
     reps = rt.get("replicas") or {}
     if not reps:
         lines.append("  (no serving replicas registered)")
         return "\n".join(lines)
     lines.append(f"  {'rank':>4s} {'addr':>21s} {'cap':>4s} {'depth':>6s} "
                  f"{'hbm headroom':>13s} {'served':>7s} {'shed':>5s} "
-                 f"{'rerouted':>9s}  tenants pinned")
+                 f"{'rerouted':>9s} {'hedged':>7s} {'breaker':>9s}  "
+                 f"tenants pinned")
     for r, row in sorted(reps.items(), key=lambda kv: int(kv[0])):
         depth = (f"{row.get('queue_depth', 0)}"
                  f"+{row.get('router_inflight', 0)}")
@@ -141,7 +146,9 @@ def render_replicas(st: Dict) -> str:
             f"{row.get('capacity', 0):>4d} {depth:>6s} "
             f"{_fmt_bytes(row.get('hbm_headroom_bytes')):>13s} "
             f"{row.get('served', 0):>7d} {row.get('shed', 0):>5d} "
-            f"{row.get('rerouted_away', 0):>9d}  {pins}")
+            f"{row.get('rerouted_away', 0):>9d} "
+            f"{row.get('hedged_away', 0):>7d} "
+            f"{row.get('breaker', 'closed'):>9s}  {pins}")
     return "\n".join(lines)
 
 

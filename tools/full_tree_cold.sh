@@ -638,6 +638,155 @@ if [ $rc -ne 0 ]; then
   echo "router smoke (merge) failed (rc=$rc); fix router trace propagation before the full tree" >&2
   exit $rc
 fi
+# tail-tolerance chaos smoke (ISSUE-16): the same 2-replica fleet, but
+# replica 1 is seeded SICK (3s dispatch stalls) instead of killed, with
+# hedging + health breakers armed — the 12-request flood must complete
+# bit-identical to the oracle with >=1 hedge fired/won/loser-cancelled,
+# replica 1's breaker must OPEN under the stalls and RECOVER via a
+# half-open probe once the stalls are exhausted; all asserted from the
+# artifact JSON
+HT=$(mktemp -d /tmp/cylon_hedge_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_ROUTER_HEDGE_MS=200 \
+    CYLON_TPU_ROUTER_BREAKER_FAILURES=2 \
+    CYLON_TPU_ROUTER_BREAKER_COOLDOWN_S=1.5 \
+    python - "$HT" <<'PYEOF'
+import json, os, subprocess, sys, threading, time
+
+sys.path.insert(0, os.getcwd())
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from cylon_tpu import elastic
+from cylon_tpu.exec import chunked_join
+from cylon_tpu.router import QueryRouter, RouterClient
+from cylon_tpu.status import CylonError
+
+td = sys.argv[1]
+router = QueryRouter(world=3, heartbeat_timeout_s=2.5).start()
+addr = f"{router.address[0]}:{router.address[1]}"
+base_env = {k: v for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS",
+                         "CYLON_TPU_FAULT_PLAN")}
+# the shared journal is the WORKERS' cache: the driver computes its
+# oracles journal-off, so the flood replays nothing pre-seeded
+base_env.update(CYLON_TPU_HEARTBEAT_S="0.1",
+                CYLON_TPU_HEARTBEAT_TIMEOUT_S="2.5",
+                CYLON_TPU_COORD_RECONNECT_S="0",
+                CYLON_TPU_DURABLE_DIR=os.path.join(td, "journal"))
+procs = []
+for r in range(2):
+    env = dict(base_env)
+    if r == 1:
+        env["CYLON_TPU_FAULT_PLAN"] = ("router.pass.r1@1=replica_sick;"
+                                       "router.pass.r1@2=replica_sick")
+        env["CYLON_TPU_FAULT_DELAY_S"] = "3"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "tests.router_worker", str(r), "3", addr],
+        env=env))
+try:
+    agent = elastic.Agent(addr, 2, interval_s=0.1, timeout_s=2.5,
+                          reconnect_s=0.0).start()
+    deadline = time.monotonic() + 120
+    while router.router_status()["replicas_live"] < 2:
+        assert time.monotonic() < deadline, "replicas never registered"
+        time.sleep(0.1)
+    cli = RouterClient(addr)
+    def mk(seed):
+        rg = np.random.default_rng(seed)
+        n = 1200
+        return ({"k": rg.integers(0, n, n).astype(np.int64),
+                 "a": rg.random(n).astype(np.float32)},
+                {"k": rg.integers(0, n, n).astype(np.int64),
+                 "b": rg.random(n).astype(np.float32)})
+    inputs = [mk(200 + i) for i in range(4)]
+    oracles = [chunked_join(l, r, on="k", passes=2, mode="hash")[0]
+               for l, r in inputs]
+    outs, errs, lock = {}, [], threading.Lock()
+    def one(i):
+        l, r = inputs[i % 4]
+        try:
+            res, stats = cli.route(f"tenant-{i % 4}", "kjoin", l, r,
+                                   on="k", passes=2, mode="hash",
+                                   timeout_s=300)
+            with lock:
+                outs[i] = res
+        except CylonError as e:
+            with lock:
+                errs.append((i, e))
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(12)]
+    for t in threads:
+        t.start()
+        time.sleep(0.05)
+    for t in threads:
+        t.join(360)
+    assert all(not t.is_alive() for t in threads), "a routed request hung"
+    assert not errs, errs  # a SICK replica only stalls, nothing may fail
+    for i, res in outs.items():
+        base = oracles[i % 4]
+        assert set(res) == set(base), i
+        for k in res:
+            np.testing.assert_array_equal(np.asarray(res[k]),
+                                          np.asarray(base[k]), err_msg=k)
+    # ride-through: once the seeded stalls are exhausted, a half-open
+    # probe must re-close replica 1's breaker
+    deadline = time.monotonic() + 90
+    while router.router_status()["breakers"].get("1") != "closed":
+        assert time.monotonic() < deadline, "breaker never re-closed"
+        l, r = inputs[0]
+        try:
+            cli.route("tenant-0", "kjoin", l, r, on="k", passes=2,
+                      mode="hash", timeout_s=300)
+        except CylonError:
+            pass
+        time.sleep(0.3)
+    st = router.router_status()
+    with open(f"{td}/summary.json", "w") as fh:
+        json.dump({"served": len(outs), "router": st}, fh, indent=1,
+                  sort_keys=True)
+finally:
+    router.stop()
+    for p in procs:
+        try:
+            p.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+assert procs[0].returncode == 0, procs[0].returncode
+assert procs[1].returncode == 0, procs[1].returncode
+print(f"tail-tolerance smoke: 12/12 bit-identical under a sick replica "
+      f"(hedges fired={st['hedges_fired']} won={st['hedges_won']})")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "tail-tolerance smoke (run) failed (rc=$rc); fix hedging/breakers before the full tree" >&2
+  rm -rf "$HT"; exit $rc
+fi
+python - "$HT" <<'PYEOF'
+import json, sys
+td = sys.argv[1]
+s = json.load(open(f"{td}/summary.json"))
+rt = s["router"]
+r1 = rt["replicas"]["1"]
+assert s["served"] == 12, s
+assert rt["hedges_fired"] >= 1, rt
+assert rt["hedges_won"] >= 1, rt
+assert rt["hedges_lost_cancelled"] >= 1, rt
+assert r1["hedged_away"] >= 1, r1
+assert r1["breaker_opens"] >= 1, r1
+assert r1["breaker_probes"] >= 1, r1
+assert rt["breakers"]["1"] == "closed", rt
+print(f"tail-tolerance smoke ok: hedges fired={rt['hedges_fired']} "
+      f"won={rt['hedges_won']} cancelled={rt['hedges_lost_cancelled']}; "
+      f"replica 1 breaker opened {r1['breaker_opens']}x, re-closed "
+      f"after {r1['breaker_probes']} probe(s)")
+PYEOF
+rc=$?
+rm -rf "$HT"
+if [ $rc -ne 0 ]; then
+  echo "tail-tolerance smoke (artifact) failed (rc=$rc); fix hedging/breakers before the full tree" >&2
+  exit $rc
+fi
 # planner smoke (ISSUE-9): TPC-H Q10 (4-way join) through the logical
 # planner on the world-8 CPU mesh — the artifact JSON must record at
 # least one elided shuffle and the planned result must be bit-identical
