@@ -46,6 +46,8 @@ RULES: Dict[str, str] = {
              "route/placement/reroute control path",
     "CY111": "blocking RPC or fsync reachable while a placement/"
              "membership lock is held",
+    "CY112": "optimizer rule reads observed statistics but no plan "
+             "fingerprint builder folds the strategy choice",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
 }
@@ -141,6 +143,16 @@ PLAN_ROOT_NAMES = frozenset({"optimize", "execute", "run_service"})
 PLAN_ROOT_PREFIXES = ("_rule_", "_lower", "_stage", "_exec", "_fused",
                       "plane_annotation")
 PLAN_FP_TOKEN = "fingerprint"
+
+#: observed-statistics readers an optimizer rule may steer on, for
+#: CY112: a strategy picked FROM statistics is part of the program the
+#: plan compiles to — if no plan fingerprint builder folds the chosen
+#: strategies (strategy_spec) into the fingerprint, a catalog change
+#: flips the strategy under a stale cache key and the journal/serve
+#: caches replay the wrong program's result (the CY103/CY109 bug class,
+#: lifted from knobs and realized layouts to planner decisions)
+ADAPTIVE_STATS_READS = frozenset({"lookup_stats", "column_stats"})
+STRATEGY_FOLD_TOKEN = "strategy_spec"
 
 #: producers whose RESULT is a jit shape/layout derived from REALIZED
 #: data (observed bit widths, dictionary sizes — the PR-10 compression
@@ -1232,6 +1244,67 @@ def _check_plan_fingerprint(prog: _Program, mod: _Module) -> None:
             "knob on the optimizer/executor path"))
 
 
+def _check_adaptive_fingerprint(prog: _Program, mod: _Module) -> None:
+    """CY112: an optimizer rule or planner root (module under
+    ``cylon_tpu.plan``; roots ``optimize``/``execute``/``run_service``
+    or ``_rule_*``) from which an observed-statistics read
+    (``lookup_stats``/``column_stats``) is reachable, while no plan
+    fingerprint builder (a ``*fingerprint*`` function under the plan
+    package) reaches ``strategy_spec``.
+
+    The invariant: a strategy the planner picked FROM statistics
+    changes the physical program, so it must ride the plan fingerprint
+    — the durable-journal / serve result-cache key.  If the rule can
+    see the catalog but the fingerprint cannot see the choice, a
+    catalog update flips the strategy under an unchanged key and the
+    cache replays the other strategy's program.  Like CY108 the fix is
+    structural (fold optimizer.strategy_spec(phys) into the fingerprint
+    header), so one complete fingerprint builder clears every root
+    package-wide."""
+    if not mod.name.startswith(PLAN_MODULE_PREFIX):
+        return
+    roots = [f for f in mod.funcs.values()
+             if f.qual.rsplit(".", 1)[-1] in PLAN_ROOT_NAMES
+             or f.qual.rsplit(".", 1)[-1].startswith("_rule_")]
+    hot = []
+    for f in roots:
+        reads: Set[str] = set()
+        for q in prog.reachable(f):
+            fn = prog.by_qual.get(q)
+            if fn is not None:
+                reads |= fn.call_finals & ADAPTIVE_STATS_READS
+        if reads:
+            hot.append((f, reads))
+    if not hot:
+        return
+    folded = False
+    for f in prog.by_qual.values():
+        if not f.module.startswith(PLAN_MODULE_PREFIX):
+            continue
+        if PLAN_FP_TOKEN not in f.qual.rsplit(".", 1)[-1]:
+            continue
+        for q in prog.reachable(f):
+            fn = prog.by_qual.get(q)
+            if fn is not None and STRATEGY_FOLD_TOKEN in fn.call_finals:
+                folded = True
+                break
+        if folded:
+            break
+    if folded:
+        return
+    for f, reads in hot:
+        mod.findings.append(Finding(
+            "CY112", mod.path, f.lineno,
+            f"planner path `{f.qual.rsplit('.', 1)[-1]}` reads observed "
+            f"statistics ({', '.join(sorted(reads))}) but no plan "
+            f"fingerprint builder folds the strategy choice — a catalog "
+            f"update would flip the physical strategy under an unchanged "
+            f"cache key",
+            "fold optimizer.strategy_spec(phys) into the fingerprint "
+            "header (LogicalPlan.fingerprint already shows the shape) or "
+            "stop steering on catalog statistics in this rule"))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -1271,6 +1344,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_router_blocking(prog, mod)
         _check_lock_held_blocking(prog, mod)
         _check_plan_fingerprint(prog, mod)
+        _check_adaptive_fingerprint(prog, mod)
         for f in mod.funcs.values():
             if f.qual in traced:
                 _Taint(f, mod, mod.findings).run()

@@ -392,6 +392,110 @@ def _trace_plan_join_groupby(ctx) -> Dict[str, Dict]:
     return out
 
 
+def _plan_broadcast_query(ctx):
+    """A fact⋈dim join whose dimension side is tiny: the shape the
+    adaptive planner's broadcast-hash rule exists for.  Metadata alone
+    (scan column nbytes) is enough to pick the dim side, so no
+    statistics catalog is needed."""
+    import numpy as np
+
+    from ..table import Table
+
+    world, cap = GRID["world"], GRID["shard_cap"]
+    n = world * cap * 4
+    rng = np.random.default_rng(17)
+    fact = Table.from_numpy(
+        ["k", "v"],
+        [rng.integers(0, 64, size=n).astype(np.int32),
+         rng.standard_normal(n)],
+        ctx=ctx, capacity=n)
+    dim = Table.from_numpy(
+        ["k", "w"],
+        [np.arange(64, dtype=np.int32),
+         (np.arange(64) % 7).astype(np.int64)],
+        ctx=ctx, capacity=64)
+    return fact.plan().join(dim.plan(), on="k", how="inner")
+
+
+def _trace_plan_salted_query(ctx):
+    """Zipf-skewed fact⋈dim then NUNIQUE grouped on the (collision-
+    prefixed) join key — the one shape the skew-salt rule accepts."""
+    import numpy as np
+
+    from ..table import Table
+
+    world, cap = GRID["world"], GRID["shard_cap"]
+    n = world * cap * 4
+    rng = np.random.default_rng(23)
+    k = (np.minimum(rng.zipf(1.3, size=n), 50) - 1).astype(np.int32)
+    fact = Table.from_numpy(
+        ["k", "u"],
+        [k, rng.integers(0, 97, size=n).astype(np.int64)],
+        ctx=ctx, capacity=n)
+    dim = Table.from_numpy(
+        ["k", "w"],
+        [np.arange(64, dtype=np.int32),
+         np.arange(64, dtype=np.int64)],
+        ctx=ctx, capacity=64)
+    return (fact.plan().join(dim.plan(), on="k", how="inner")
+            .groupby(["l_k"], {"u": ["nunique"]}))
+
+
+def _trace_plan_broadcast_join(ctx) -> Dict[str, Dict]:
+    """Adaptive broadcast-hash join budget: the broadcast arm must move
+    the tiny dimension with exactly ONE all_gather and ZERO all_to_all —
+    the shuffle arm (adaptive off, same plan) pays two full exchanges.
+    Any future edit that un-packs the broadcast plane or sneaks a data
+    shuffle back under the broadcast join regresses this golden."""
+    out: Dict[str, Dict] = {}
+    for label, adaptive in (("broadcast", "1"), ("shuffle", "0")):
+        with config.knob_env(CYLON_TPU_PLAN="1",
+                             CYLON_TPU_PLAN_ADAPTIVE=adaptive,
+                             CYLON_TPU_SHUFFLE="bucketed",
+                             CYLON_TPU_SHUFFLE_PACK="1"):
+            q = _plan_broadcast_query(ctx)
+            with _LaunchMeter() as meter:
+                q.execute()
+            out[label] = {"collectives": dict(meter.totals),
+                          "informational": {}}
+    return out
+
+
+def _trace_plan_salted_groupby(ctx) -> Dict[str, Dict]:
+    """Skew-salted NUNIQUE budget.  The statistics catalog is seeded
+    OUTSIDE the meter by one profiled adaptive-off run into a throwaway
+    stats dir (the salt rule only fires on *observed* catalog skew);
+    the salted arm then pays exactly one extra tiny exchange over the
+    plain arm — the pre-combine spread across salt buckets."""
+    import tempfile
+
+    out: Dict[str, Dict] = {}
+    with tempfile.TemporaryDirectory() as stats_dir:
+        with config.knob_env(CYLON_TPU_PLAN="1",
+                             CYLON_TPU_PLAN_ADAPTIVE="0",
+                             CYLON_TPU_SHUFFLE="bucketed",
+                             CYLON_TPU_SHUFFLE_PACK="1",
+                             CYLON_TPU_PROFILE="1",
+                             CYLON_TPU_STATS_DIR=stats_dir):
+            _trace_plan_salted_query(ctx).execute()
+        for label, adaptive in (("salted", "1"), ("plain", "0")):
+            # broadcast threshold 0 keeps the join shuffled in both arms
+            # so the delta below is the salt pipeline alone
+            with config.knob_env(CYLON_TPU_PLAN="1",
+                                 CYLON_TPU_PLAN_ADAPTIVE=adaptive,
+                                 CYLON_TPU_PLAN_BROADCAST_BYTES="0",
+                                 CYLON_TPU_PLAN_SKEW_SALT="1.2",
+                                 CYLON_TPU_SHUFFLE="bucketed",
+                                 CYLON_TPU_SHUFFLE_PACK="1",
+                                 CYLON_TPU_STATS_DIR=stats_dir):
+                q = _trace_plan_salted_query(ctx)
+                with _LaunchMeter() as meter:
+                    q.execute()
+                out[label] = {"collectives": dict(meter.totals),
+                              "informational": {}}
+    return out
+
+
 ENTRIES = {
     "shuffle_bucketed": _trace_shuffle_bucketed,
     "task_shuffle": _trace_task_shuffle,
@@ -399,6 +503,8 @@ ENTRIES = {
     "shuffle_ragged": _trace_shuffle_ragged,
     "chunked_pass": _trace_chunked_pass,
     "plan_join_groupby": _trace_plan_join_groupby,
+    "plan_broadcast_join": _trace_plan_broadcast_join,
+    "plan_salted_groupby": _trace_plan_salted_groupby,
 }
 
 

@@ -230,6 +230,33 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "CYLON_TPU_SHUFFLE: each mode builds differently-keyed stage "
             "programs, so no cache-key participation; results are "
             "bit-identical either way."),
+    _K("CYLON_TPU_PLAN_ADAPTIVE", "enum", "auto", RUNTIME,
+       choices=("1", "on", "0", "off", "auto"),
+       accessors=("cylon_tpu.plan.optimizer.planner_adaptive",),
+       help="Statistics-driven physical strategy selection on top of the "
+            "CYLON_TPU_PLAN optimizer: broadcast-hash joins for "
+            "dimension-sized sides and skew-salted NUNIQUE repartition, "
+            "picked by the plan/cost.py model from the stats catalog (or "
+            "conservative metadata bounds when no catalog exists).  "
+            "auto (default) is OFF this release — opt in with 1/on until "
+            "the TPU calibration round lands.  off is bit-identical to "
+            "the PR-9 planner.  Chosen strategies are folded into the "
+            "plan fingerprint and stage keys, so no cache-key "
+            "participation is needed."),
+    _K("CYLON_TPU_PLAN_BROADCAST_BYTES", "int", 1 << 20, RUNTIME,
+       accessors=("cylon_tpu.plan.cost.broadcast_threshold_bytes",),
+       help="Adaptive-planner broadcast-hash-join threshold: a join side "
+            "whose estimated payload is at most this many bytes may be "
+            "all_gather-replicated instead of hash-shuffled (cost model "
+            "still has to agree).  Per-shard post-gather footprint is "
+            "world x this bound."),
+    _K("CYLON_TPU_PLAN_SKEW_SALT", "float", 4.0, RUNTIME,
+       accessors=("cylon_tpu.plan.cost.skew_salt_factor",),
+       help="Adaptive-planner skew threshold: salt a NUNIQUE repartition "
+            "when the catalog-observed shard-placement skew "
+            "(max/mean shard rows) of the aggregate's input meets this "
+            "factor.  Salting is exact (value-hash bucketing + integer "
+            "COUNTSUM combine) but costs one extra small exchange."),
     _K("CYLON_TPU_MAX_STRING_WIDTH", "int", 4096, RUNTIME,
        help="Widest byte matrix a string column may ingest without an "
             "explicit string_width= (HBM guard)."),

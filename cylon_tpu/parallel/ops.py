@@ -276,6 +276,105 @@ def _record_exchange(cols, packed: bool, family: str,
                       collective_launches=launches, rows=rows_exchanged)
 
 
+def _record_broadcast(cols, packed: bool, world: int, rows_buf: int) -> None:
+    """Account one broadcast replication (static shape metadata only, no
+    device sync).  Deliberately NOT ``shuffle.exchanges`` — tests pin
+    exchange counts per plan shape, and a broadcast is the strategy that
+    AVOIDED an exchange; it gets its own counter."""
+    launches = 1 if packed else 1 + shuffle_mod.buffer_count(cols)
+    bytes_sent = rows_buf * world * _row_bytes(cols, packed)
+    obs_metrics.counter_add("shuffle.broadcasts")
+    obs_metrics.counter_add("shuffle.collective_launches", launches)
+    obs_metrics.counter_add("shuffle.bytes_sent", bytes_sent)
+    obs_metrics.hist_observe("shuffle.bytes_per_exchange", bytes_sent)
+    obs_spans.instant("shuffle.broadcast_done", packed=packed,
+                      collective_launches=launches,
+                      rows=rows_buf * world)
+
+
+def broadcast_gather(t):
+    """Replicate a (small) distributed table onto every shard — the
+    broadcast-hash join's build side.
+
+    Packed path runs exactly ONE all_gather: the shard's rows pack into
+    the bit-plane, one extra meta row carries the live-row count in
+    word 0 (a counts all_gather would be a second launch — the budget
+    goldens pin broadcast joins at 1 gather), and every shard unpacks
+    the [world, cap+1, words] result, compacting live rows front-wise
+    in source-rank order.  The per-buffer fallback (packing disabled)
+    gathers counts plus each buffer.  No compression: the build side is
+    dimension-sized by the cost model's admission, so spec estimation
+    overhead cannot pay for itself.
+
+    The result is replicated (same rows, same order, every shard) and
+    feeds the collective-free local join probe; it never escapes the
+    executor."""
+    from .. import resilience
+    from ..ops import compact as compact_mod
+    from ..table import Table
+
+    world = t.num_shards
+    if world == 1:
+        return t
+    ctx = t.ctx
+    names = t.names
+    cap = t.shard_capacity
+    out_cap = cap * world
+    pack = plane_mod.pack_enabled()
+
+    def gather():
+        resilience.fault_point("broadcast")
+        if pack:
+            def bcfn(tt):
+                plane = plane_mod.pack_plane(tt.columns)
+                meta = jnp.zeros((1, plane.shape[1]), dtype=plane.dtype)
+                meta = meta.at[0, 0].set(
+                    tt.row_counts[0].astype(plane.dtype))
+                g = collectives.allgather(
+                    jnp.concatenate([plane, meta], axis=0), axis=0)
+                counts = g[:, cap, 0].astype(jnp.int32)
+                rows = g[:, :cap, :].reshape(world * cap, -1)
+                live = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                        < counts[:, None]).reshape(world * cap)
+                perm, m = compact_mod.compact_indices(live)
+                valid = jnp.arange(out_cap, dtype=jnp.int32) < m
+                cols = plane_mod.unpack_plane(
+                    jnp.take(rows, perm, axis=0, mode="clip"),
+                    tt.columns, valid_mask=valid)
+                return Table(cols, jnp.reshape(m, (1,)), names, ctx)
+        else:
+            def bcfn(tt):
+                counts = collectives.allgather(
+                    tt.row_counts, axis=0).reshape(world).astype(jnp.int32)
+                live = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                        < counts[:, None]).reshape(world * cap)
+                perm, m = compact_mod.compact_indices(live)
+                valid = jnp.arange(out_cap, dtype=jnp.int32) < m
+                cols = []
+                for c in tt.columns:
+                    gd = collectives.allgather(c.data, axis=0).reshape(
+                        (world * cap,) + c.data.shape[1:])
+                    gv = collectives.allgather(c.validity, axis=0).reshape(
+                        world * cap)
+                    gl = None
+                    if c.lengths is not None:
+                        gl = collectives.allgather(
+                            c.lengths, axis=0).reshape(world * cap)
+                    cols.append(Column(gd, gv, gl, c.dtype).take(
+                        perm, valid_mask=valid))
+                return Table(tuple(cols), jnp.reshape(m, (1,)), names, ctx)
+
+        with obs_spans.span("shuffle.broadcast", packed=pack, world=world):
+            out = _shard_map(ctx, bcfn, ("bcast", pack, out_cap),
+                             _shapes_key(t))(t)
+        _record_broadcast(t.columns, pack, world, cap + 1 if pack else cap)
+        return out
+
+    out, _attempts = resilience.retry_call(
+        gather, policy=ctx.collective_retry_policy(), site="broadcast")
+    return out
+
+
 def _shuffled(t, key_idx: Tuple[int, ...], mode: str = "hash",
               opts: SortOptions | None = None):
     """partition -> all-to-all -> compact; returns a new distributed Table.
@@ -563,7 +662,8 @@ def finalize_groupby_columns(fcols, nkeys: int, aggs, partial_index,
 def distributed_groupby(t, by_idx: Tuple[int, ...],
                         aggs: Tuple[Tuple[int, AggOp], ...], ddof: int,
                         pipeline: bool = False,
-                        pre_partitioned: bool = False):
+                        pre_partitioned: bool = False,
+                        salt: int = 0):
     """Two-phase distributed group-by.
 
     ``pipeline=False`` — the reference's DistributedHashGroupBy
@@ -581,6 +681,18 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
     is SKIPPED and the final combine folds each group's single partial
     locally — bit-identical to the shuffled path, because combining one
     partial is the identity for every combine op.
+
+    ``salt > 1`` — the adaptive planner's skew-salted repartition,
+    valid ONLY for the all-NUNIQUE single-distinct-column shape (it
+    raises otherwise): instead of co-locating each group entirely on
+    ``hash(keys)``'s rank (one zipfian-hot key = one overloaded rank),
+    rows spread over ``hash(keys, value_bucket)`` where ``value_bucket
+    = hash(value) % salt``.  Exact by construction: buckets PARTITION
+    the value space, so every distinct (key, value) pair lands on
+    exactly one rank, the per-rank local NUNIQUE counts disjoint value
+    sets, and the integer COUNTSUM combine over a second (tiny,
+    group-sized) exchange sums them — bit-identical to the unsalted
+    plan, at the price of that extra small exchange.
     """
     from ..table import Table, _groupby_output_names, _local_groupby, _shard_wise
 
@@ -591,6 +703,13 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
         raise CylonError(Code.Invalid,
                          "pre_partitioned group-by cannot carry NUNIQUE "
                          "(no partial/combine decomposition)")
+    salt = int(salt)
+    if salt > 1 and (pre_partitioned
+                     or any(op != AggOp.NUNIQUE for _, op in aggs)
+                     or len({ci for ci, _ in aggs}) != 1):
+        raise CylonError(Code.Invalid,
+                         "salted group-by requires the all-NUNIQUE "
+                         "single-distinct-column shape")
     if any(op == AggOp.NUNIQUE for _, op in aggs):
         # NUNIQUE does not decompose into partial+combine columns; instead
         # co-locate raw rows by key (shuffle) and run ONE local group-by —
@@ -617,6 +736,34 @@ def distributed_groupby(t, by_idx: Tuple[int, ...],
 
             work = _shard_wise(ctx, dedup_fn, work,
                                key=("nunique_dedup", involved))
+        if salt > 1:
+            from ..ops import hashing as hashing_mod
+
+            vpos = aggs_p[0][0]
+            nkeys = len(by_p)
+            sn = work.names + ("__salt__",)
+
+            def salt_fn(tt):
+                bucket = (hashing_mod.hash_columns([tt.columns[vpos]])
+                          % jnp.uint32(salt)).astype(jnp.int32)
+                live = jnp.arange(bucket.shape[0],
+                                  dtype=jnp.int32) < tt.row_counts[0]
+                cols = tuple(tt.columns) + (
+                    Column(bucket, live, None, dtypes.int32),)
+                return Table(cols, tt.row_counts, sn, ctx)
+
+            salted = _shard_wise(ctx, salt_fn, work,
+                                 key=("nunique_salt", vpos, salt))
+            spread = shuffle(salted, by_p + (len(involved),))
+            part = _local_groupby(spread, by_p, aggs_p, ddof,
+                                  pipeline=False)
+            combined = shuffle(part, tuple(range(nkeys)))
+            out = _local_groupby(
+                combined, tuple(range(nkeys)),
+                tuple((nkeys + i, AggOp.COUNTSUM)
+                      for i in range(len(aggs_p))), ddof, pipeline=False)
+            obs_spans.instant("shuffle.salted", buckets=salt, keys=nkeys)
+            return out.rename(names_out)
         shuffled = shuffle(work, by_p)
         out = _local_groupby(shuffled, by_p, aggs_p, ddof, pipeline=False)
         return out.rename(names_out)

@@ -82,13 +82,23 @@ def execute(plan: "ir.LogicalPlan", ctx=None, pass_guard=None,
         prof = profile_mod.PlanProfile()
 
     fp: Optional[str] = None
+    sfp: Optional[str] = None
     journal = None
     if durable.enabled() or (prof is not None and stats_catalog.enabled()):
         fp = plan.fingerprint()
+    if prof is not None and fp is not None and stats_catalog.enabled():
+        # the CATALOG is keyed by the strategy-independent base
+        # fingerprint: observations must describe what the query IS, not
+        # what the planner chose, or a strategy flip would orphan the
+        # very statistics that justified it.  With the adaptive knob off
+        # the full fingerprint IS the base one (no strategies to fold),
+        # so the second content hash is skipped.
+        sfp = (plan.base_fingerprint() if optimizer.planner_adaptive()
+               else fp)
     if prof is not None:
         prof.fingerprint = fp
-        if fp is not None and stats_catalog.enabled():
-            prof.estimates = stats_catalog.lookup(fp)
+        if sfp is not None:
+            prof.estimates = stats_catalog.lookup(sfp)
     if durable.enabled():
         journal = durable.open_run(fp, "plan", world=world)
         if journal is not None and journal.is_complete():
@@ -118,6 +128,11 @@ def execute(plan: "ir.LogicalPlan", ctx=None, pass_guard=None,
                                     phys.shuffles_elided)
             obs_metrics.counter_add("plan.columns_pruned",
                                     phys.columns_pruned)
+        if phys.adaptive:
+            obs_metrics.counter_add("plan.broadcast_joins",
+                                    phys.broadcast_joins)
+            obs_metrics.counter_add("plan.keys_salted",
+                                    phys.keys_salted)
         with obs_spans.span("plan.execute", world=world, nodes=phys.nodes,
                             elided=phys.shuffles_elided,
                             pruned=phys.columns_pruned, optimized=enabled):
@@ -150,8 +165,8 @@ def execute(plan: "ir.LogicalPlan", ctx=None, pass_guard=None,
     if prof is not None:
         prof.finalize(phys, time.perf_counter_ns() - t_run0)
         prof.attach_fleet_skew(ctx)
-        if fp is not None and stats_catalog.enabled():
-            stats_catalog.record(fp, prof.catalog_record(plan))
+        if sfp is not None:
+            stats_catalog.record(sfp, prof.catalog_record(plan))
         prof.export()
 
     if journal is not None:
@@ -340,6 +355,17 @@ class _Executor:
         obs_spans.instant("plan.shuffle_elided", side=side,
                           keys=",".join(keys))
 
+    def _broadcast(self, t, side: str, p: optimizer.Phys):
+        from ..parallel import ops as par_ops
+
+        self._guard()
+        est = p.ann.get("broadcast") or {}
+        with obs_spans.span("plan.stage", kind="broadcast", side=side,
+                            columns=len(t.names),
+                            est_bytes=est.get("bytes"),
+                            source=est.get("source")):
+            return par_ops.broadcast_gather(t)
+
     def _join_inputs(self, p: optimizer.Phys):
         node: ir.Join = p.node  # type: ignore[assignment]
         lc, rc = p.children
@@ -359,10 +385,16 @@ class _Executor:
             lt = self._shuffle(lt, la[1], side="left")
         elif la[0] == "elide":
             self._note_elided("left", la[1])
+        elif la[0] == "broadcast":
+            lt = self._broadcast(lt, "left", p)
         if ra[0] == "shuffle":
             rt = self._shuffle(rt, ra[1], side="right")
         elif ra[0] == "elide":
             self._note_elided("right", ra[1])
+        elif ra[0] == "broadcast":
+            rt = self._broadcast(rt, "right", p)
+        # ("keep", keys): the broadcast join's probe side stays exactly
+        # where it is — zero bytes moved
         return lt, rt
 
     def _join_cfg(self, node: ir.Join, lt, rt):
@@ -418,8 +450,9 @@ class _Executor:
                                                   node.ddof,
                                                   pre_partitioned=True)
             else:
-                out = par_ops.distributed_groupby(t, by_idx, aggs,
-                                                  node.ddof)
+                out = par_ops.distributed_groupby(
+                    t, by_idx, aggs, node.ddof,
+                    salt=int(p.ann.get("salt", 0)))
         return out.rename(list(node.names))
 
     def _fused_join_agg(self, p: optimizer.Phys):

@@ -62,11 +62,15 @@ def explain(plan, optimized: Optional[bool] = None,
 
 
 def _header(phys: optimizer.PhysPlan) -> str:
+    # adaptive fields render ONLY when the adaptive planner ran — the
+    # default header stays byte-identical to the PR-9 renderer
+    adaptive = (f" adaptive=on broadcast_joins={phys.broadcast_joins} "
+                f"keys_salted={phys.keys_salted}" if phys.adaptive else "")
     return (f"plan [world={phys.world} mode="
             f"{'optimized' if phys.enabled else 'eager'} "
             f"nodes={phys.nodes} "
             f"shuffles_elided={phys.shuffles_elided} "
-            f"columns_pruned={phys.columns_pruned}]")
+            f"columns_pruned={phys.columns_pruned}{adaptive}]")
 
 
 def _shuffle_note(ann: tuple) -> str:
@@ -74,6 +78,10 @@ def _shuffle_note(ann: tuple) -> str:
         return "local"
     if ann[0] == "elide":
         return f"ELIDED (already hash({','.join(ann[1])}))"
+    if ann[0] == "broadcast":
+        return f"BROADCAST({','.join(ann[1])})"
+    if ann[0] == "keep":
+        return "kept in place"
     return f"shuffle({','.join(ann[1])})"
 
 
@@ -112,12 +120,17 @@ def _render(plan, p: optimizer.Phys, lines: List[str], depth: int,
     elif isinstance(n, ir.Join):
         shared = "  [SHARED SCAN: one exchange feeds both sides]" \
             if p.ann.get("shared") else ""
+        bcast = ""
+        b = p.ann.get("broadcast")
+        if isinstance(b, dict):
+            bcast = (f"  [ADAPTIVE: broadcast {b.get('side')} side, "
+                     f"est {b.get('bytes')}B ({b.get('source')})]")
         lines.append(
             f"{pad}join {n.how}/{n.algorithm} on "
             f"{','.join(n.left_on)} = {','.join(n.right_on)}  "
             f"[left: {_shuffle_note(p.ann.get('left', ()))}, "
             f"right: {_shuffle_note(p.ann.get('right', ()))}]"
-            f"{shared}{suffix}")
+            f"{shared}{bcast}{suffix}")
     elif isinstance(n, ir.Aggregate):
         mode = p.ann.get("mode", "eager")
         if mode == "elided":
@@ -128,6 +141,11 @@ def _render(plan, p: optimizer.Phys, lines: List[str], depth: int,
             note = "  [local]"
         else:
             note = f"  [shuffle({','.join(n.by)})]"
+        if p.ann.get("salt"):
+            se = p.ann.get("salt_est") or {}
+            note += (f"  [ADAPTIVE: salted x{p.ann['salt']}, observed "
+                     f"skew {se.get('skew')} >= {se.get('factor')} "
+                     f"({se.get('source')})]")
         if p.ann.get("fuse"):
             note += "  [FUSED with join: one shard body]"
         aggs = ", ".join(f"{op.name.lower()}({c})" for c, op in n.aggs)

@@ -370,25 +370,59 @@ class LogicalPlan:
         """Plan-granularity content fingerprint: op spec chain × world ×
         pruned input content × trace-knob config.  The durable journal
         and the serve result cache key planned runs by this — one entry
-        per multi-op query."""
-        from .. import durable
+        per multi-op query.
+
+        When the adaptive planner chose physical strategies (broadcast
+        joins, salted repartitions), ``optimizer.strategy_spec`` is
+        folded into the header — a stats-dependent choice the cache key
+        omitted would serve the wrong program (the CY103/CY109 lesson;
+        cylint CY112 machine-checks this fold).  With no strategies
+        chosen the header is byte-identical to the pre-adaptive
+        fingerprint, so existing journals stay valid."""
         from . import optimizer
 
         phys = optimizer.optimize(self, enabled=True)
+        world = self._world()
+        strat = optimizer.strategy_spec(phys)
+        header = ((self.root.spec(), world) if not strat
+                  else (self.root.spec(), world, ("adaptive", strat)))
+        return self._content_fingerprint(phys, header)
+
+    def base_fingerprint(self) -> str:
+        """Strategy-INDEPENDENT content fingerprint: like
+        :meth:`fingerprint` but optimized with ``adaptive=False``, so
+        the header never carries strategy choices.  The statistics
+        catalog keys observations by this — the cost model must read
+        stats describing what the query IS regardless of what a prior
+        planner chose, and the fingerprint→optimize→lookup recursion is
+        structurally impossible (``adaptive=False`` never consults the
+        catalog).  Equal to :meth:`fingerprint` whenever no adaptive
+        strategy fired."""
+        from . import optimizer
+
+        phys = optimizer.optimize(self, enabled=True, adaptive=False)
+        return self._content_fingerprint(
+            phys, (self.root.spec(), self._world()))
+
+    def _content_fingerprint(self, phys, header) -> str:
+        from .. import durable
+        from . import optimizer
+
         frames = []
         for scan, keep in optimizer.scan_prunes(phys):
             t = self.inputs[scan.idx].project(list(keep))
             frames.append((tuple(keep), t.to_numpy()))
-        world = self._world()
-        return durable.run_fingerprint("plan", (self.root.spec(), world),
-                                       frames)
+        return durable.run_fingerprint("plan", header, frames)
 
     def approx_input_bytes(self) -> int:
         """Static HBM admission estimate (serve layer): buffer bytes of
-        the pruned scan columns — array metadata only, no device sync."""
+        the pruned scan columns — array metadata only, no device sync.
+        Strategy choices never change the pruned column sets, so the
+        base (non-adaptive) optimization suffices and costs no catalog
+        lookup."""
         from . import optimizer
 
-        phys = optimizer.optimize(self, enabled=True)
+        phys = optimizer.optimize(self, enabled=True, adaptive=False)
         total = 0
         for scan, keep in optimizer.scan_prunes(phys):
             t = self.inputs[scan.idx]

@@ -34,6 +34,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from .. import config
 from ..parallel import plane as plane_mod
 from ..status import Code, CylonError
 from . import ir
@@ -71,6 +72,14 @@ class PhysPlan:
     shuffles_elided: int = 0
     columns_pruned: int = 0
     nodes: int = 0
+    #: adaptive (statistics-driven) strategy selection was active for
+    #: this optimization — False reproduces the PR-9 planner exactly.
+    adaptive: bool = False
+    broadcast_joins: int = 0
+    keys_salted: int = 0
+    #: the plan/cost.py CostModel the adaptive rules consulted (None
+    #: when adaptive is off) — explain() renders its estimates.
+    model: object = field(default=None, repr=False)
 
 
 def hash_partitioning(names: Sequence[str], world: int) -> Partitioning:
@@ -111,13 +120,39 @@ def join_partition_alternatives(how: str, left_names: Sequence[str],
 # ---------------------------------------------------------------------------
 
 
-def optimize(plan: "ir.LogicalPlan", enabled: bool = True) -> PhysPlan:
+def planner_adaptive() -> bool:
+    """Whether :func:`optimize` additionally runs the statistics-driven
+    strategy rules (``CYLON_TPU_PLAN_ADAPTIVE``; 1/on = adaptive,
+    auto/off = the PR-9 rule-only planner — auto stays off until the
+    TPU calibration round).  Chosen strategies ride the plan
+    fingerprint and distinctly-keyed stage programs, so no cache-key
+    participation is needed."""
+    return str(config.knob("CYLON_TPU_PLAN_ADAPTIVE")) in ("1", "on")
+
+
+def optimize(plan: "ir.LogicalPlan", enabled: bool = True,
+             adaptive: Optional[bool] = None) -> PhysPlan:
     """Annotate the plan.  ``enabled=False`` produces the EAGER physical
     plan: no pruning, every distributed join/group-by shuffles, no
     sharing, no fusion — the per-op baseline the A/B arms and the
-    bit-identity gates compare against."""
+    bit-identity gates compare against.
+
+    ``adaptive`` layers the statistics-driven strategy rules (broadcast
+    joins, skew salting) on top; None defers to the
+    ``CYLON_TPU_PLAN_ADAPTIVE`` knob.  Adaptive mode NEVER changes the
+    tree shape or the column-pruning/nid numbering — only per-node
+    strategy annotations — so the base (``adaptive=False``) and
+    adaptive plans stay node-for-node comparable."""
     world = plan._world()
-    out = PhysPlan(root=None, world=world, enabled=enabled)  # type: ignore
+    if adaptive is None:
+        adaptive = planner_adaptive()
+    adaptive = bool(adaptive) and enabled and world > 1
+    out = PhysPlan(root=None, world=world, enabled=enabled,  # type: ignore
+                   adaptive=adaptive)
+    if adaptive:
+        from . import cost
+
+        out.model = cost.CostModel(plan, world, record=lookup_stats(plan))
     req = tuple(plan.root.names) if enabled else None
     out.root = _build(plan, plan.root, req, world, enabled, out)
     if enabled:
@@ -125,6 +160,29 @@ def optimize(plan: "ir.LogicalPlan", enabled: bool = True) -> PhysPlan:
     out.nodes = _count(out.root)
     _assign_nids(out.root, 0)
     return out
+
+
+def strategy_spec(phys: PhysPlan) -> tuple:
+    """The adaptive strategy choices of an optimized plan as a sorted,
+    hashable spec — ``()`` when no rule fired (or adaptive is off).
+    ``LogicalPlan.fingerprint`` folds this into the plan fingerprint so
+    a stats-dependent choice can never serve a cached program built for
+    a different strategy (the CY103/CY109 lesson; cylint CY112
+    machine-checks the fold)."""
+    out: List[tuple] = []
+
+    def walk(p: Phys) -> None:
+        b = p.ann.get("broadcast")
+        if isinstance(b, dict):
+            out.append((p.nid, "broadcast_join", b.get("side")))
+        s = p.ann.get("salt")
+        if s:
+            out.append((p.nid, "salted_groupby", int(s)))
+        for c in p.children:
+            walk(c)
+
+    walk(phys.root)
+    return tuple(sorted(out))
 
 
 def _assign_nids(p: Phys, next_id: int) -> int:
@@ -139,24 +197,29 @@ def _assign_nids(p: Phys, next_id: int) -> int:
 
 
 def lookup_stats(plan) -> Optional[dict]:
-    """ADVISORY observed-statistics lookup for this exact plan: the
-    persistent catalog record a prior profiled run left under the
-    plan's content fingerprint (per-scan column cardinality, join-key
+    """Observed-statistics lookup for this exact plan: the persistent
+    catalog record a prior profiled run left under the plan's BASE
+    content fingerprint (per-scan column cardinality, join-key
     selectivity, per-node rows/skew), or None when the catalog is
     disabled or has never seen the plan.
 
-    Deliberately NOT consulted by :func:`optimize` this PR — plans are
-    bit-identical with the catalog present or absent (tests pin it);
-    this is the feed the ROADMAP-1 cost model (broadcast joins, skew
-    salting, shuffle-vs-broadcast choice) will steer on.  Note the
-    fingerprint hashes pruned input CONTENT, so the lookup costs one
-    host gather of the scan columns — call it on planning/profiling
-    paths, not per-row hot paths."""
+    This is the adaptive planner's cost-model feed: :func:`optimize`
+    consults it (adaptive mode) to size join sides and read observed
+    skew.  Keyed by :meth:`LogicalPlan.base_fingerprint` — the
+    strategy-INDEPENDENT fingerprint — so the lookup describes what the
+    query is, not what a prior planner chose, and the
+    fingerprint→optimize recursion is impossible (the base fingerprint
+    optimizes with ``adaptive=False``, which never calls back here).
+    Plans without adaptive mode remain bit-identical with the catalog
+    present or absent (tests pin it).  Note the fingerprint hashes
+    pruned input CONTENT, so the lookup costs one host gather of the
+    scan columns — call it on planning/profiling paths, not per-row hot
+    paths."""
     from ..obs import stats_catalog
 
     if not stats_catalog.enabled():
         return None
-    return stats_catalog.lookup(plan.fingerprint())
+    return stats_catalog.lookup(plan.base_fingerprint())
 
 
 def scan_prunes(phys: PhysPlan) -> List[Tuple[ir.Scan, Tuple[str, ...]]]:
@@ -246,6 +309,8 @@ def _build(plan, node: ir.Node, req: Optional[Tuple[str, ...]], world: int,
         c = _build(plan, node.children[0], child_req, world, enabled, out)
         p = Phys(node, [c], tuple(node.names))
         _rule_shuffle_elision_agg(p, c, world, enabled, out)
+        if out.model is not None:
+            _rule_salt_agg(p, c, world, out)
         return p
 
     if isinstance(node, ir.Sort):
@@ -381,6 +446,8 @@ def _build_join(plan, node: ir.Join, req: Optional[Tuple[str, ...]],
     _rule_shuffle_elision_join(p, lc, rc, world, enabled, out)
     if enabled:
         _rule_share_scans(p, lc, rc, world, out)
+    if out.model is not None:
+        _rule_broadcast_join(p, lc, rc, world, out)
     _join_out_partitioning(p, world)
     return p
 
@@ -436,6 +503,94 @@ def _rule_share_scans(p: Phys, lc: Phys, rc: Phys, world: int,
     out.shuffles_elided += 1
 
 
+def _rule_broadcast_join(p: Phys, lc: Phys, rc: Phys, world: int,
+                         out: PhysPlan) -> None:
+    """Adaptive rule: broadcast-hash join.  When the cost model says one
+    side is dimension-sized (estimate at or under the broadcast
+    threshold AND cheaper on the wire than shuffling), replicate that
+    side to every rank with ONE all_gather and probe locally — the big
+    side moves ZERO bytes.
+
+    Validity mirrors :func:`join_partition_alternatives`' null-keys
+    argument with sides swapped: the KEPT side's rows must each live on
+    exactly one rank and be emitted there exactly once, so the
+    broadcast side must never be null-extended (its unmatched rows are
+    replicated on every rank) — broadcast left only for inner/right
+    joins, broadcast right only for inner/left, never outer."""
+    node: ir.Join = p.node  # type: ignore[assignment]
+    model = out.model
+    if model is None or p.ann.get("shared"):
+        return
+    la = p.ann.get("left", ())
+    ra = p.ann.get("right", ())
+    if not la or la[0] == "local":
+        return
+    # (side to broadcast, its child, the other side's current ann) —
+    # profitable only when the OTHER side currently pays an exchange
+    cands = []
+    if node.how in ("inner", "right") and ra[:1] == ("shuffle",):
+        cands.append(("left", lc))
+    if node.how in ("inner", "left") and la[:1] == ("shuffle",):
+        cands.append(("right", rc))
+    best = None
+    for side, child in cands:
+        est, src = model.side_estimate(child)
+        if est > model.threshold:
+            continue
+        if best is None or est < best[2]:
+            best = (side, child, est, src)
+    if best is None:
+        return
+    side, child, est, src = best
+    own_ann = la if side == "left" else ra
+    saved = 2 if own_ann[:1] == ("shuffle",) else 1
+    big_child = rc if side == "left" else lc
+    big_est, _ = model.side_estimate(big_child)
+    if not model.broadcast_wins(est, big_est, saved):
+        return
+    lo, ro = tuple(node.left_on), tuple(node.right_on)
+    if side == "left":
+        p.ann["left"] = ("broadcast", lo)
+        p.ann["right"] = ("keep", ro)
+    else:
+        p.ann["left"] = ("keep", lo)
+        p.ann["right"] = ("broadcast", ro)
+    p.ann["broadcast"] = {"side": side, "bytes": int(est), "source": src}
+    out.broadcast_joins += 1
+
+
+def _rule_salt_agg(p: Phys, c: Phys, world: int, out: PhysPlan) -> None:
+    """Adaptive rule: skew-salted NUNIQUE repartition.  When the catalog
+    observed the aggregate's input placing ``max/mean >= salt factor``
+    rows on one rank (the zipfian-key shape), spread the exchange over
+    value-hash salt buckets and COUNTSUM-combine the per-bucket partial
+    distinct counts — exact by construction (buckets partition the
+    value space, so per-(key, bucket) distinct counts sum to the
+    per-key distinct count; integer combine).  Gated to the
+    single-distinct-column all-NUNIQUE shape the salted physical path
+    supports; no catalog evidence → no salt (conservative)."""
+    node: ir.Aggregate = p.node  # type: ignore[assignment]
+    from ..ops.groupby import AggOp
+
+    model = out.model
+    if model is None or p.ann.get("mode") != "eager":
+        return
+    if not node.aggs or any(op != AggOp.NUNIQUE for _, op in node.aggs):
+        return
+    if len({n for n, _ in node.aggs}) != 1:
+        return
+    # the estimate spans the aggregate's OWN record too: a plain
+    # groupby-on-scan has a balanced (round-robin) input, so the only
+    # observed placement skew lives on the aggregate node itself
+    skew, src = model.skew_estimate(p)
+    if skew < model.salt_factor:
+        return
+    p.ann["salt"] = world
+    p.ann["salt_est"] = {"skew": skew, "source": src,
+                         "factor": model.salt_factor}
+    out.keys_salted += 1
+
+
 def _join_out_partitioning(p: Phys, world: int) -> None:
     """Output partitioning of a join: rows land by hash of the keys the
     sides were exchanged (or already placed) on; which side's names are
@@ -449,6 +604,26 @@ def _join_out_partitioning(p: Phys, world: int) -> None:
     ra = p.ann.get("right", ())
     if not la or la[0] == "local":
         p.part = None
+        return
+    if la[0] in ("broadcast", "keep"):
+        # broadcast join: every output row derives from a KEPT-side row
+        # in place (the broadcast side is the one replicated), so the
+        # kept child's placement property survives, renamed through the
+        # join's collision-prefix rule.  Kept rows are never
+        # null-extended (the broadcast rule's validity gate), so their
+        # key values stay real.
+        kept_side = "left" if la[0] == "keep" else "right"
+        kc = p.children[0] if kept_side == "left" else p.children[1]
+        if kc.part is None or kc.part[0] != "hash" or kc.part[2] != world:
+            p.part = None
+            return
+        keep_set = set(p.keep)
+        alts = []
+        for alt in kc.part[1]:
+            mapped = tuple(node.out_name(kept_side, n) for n in alt)
+            if set(mapped) <= keep_set:
+                alts.append(mapped)
+        p.part = ("hash", tuple(alts), world) if alts else None
         return
     lkeys = la[1] if len(la) > 1 else tuple(node.left_on)
     rkeys = ra[1] if len(ra) > 1 else tuple(node.right_on)

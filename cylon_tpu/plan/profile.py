@@ -240,6 +240,15 @@ class PlanProfile:
         enabled; the host gather is the documented profiling cost."""
         rec: dict = {"world": self.world, "wall_ms": self.wall_ms(),
                      "nodes": {}, "scans": {}, "joins": {}, "filters": {}}
+        if self.phys is not None:
+            from . import optimizer as optimizer_mod
+
+            # which adaptive strategies produced these observations —
+            # diagnostic provenance (the record itself is keyed by the
+            # strategy-independent base fingerprint)
+            strat = optimizer_mod.strategy_spec(self.phys)
+            if strat:
+                rec["strategies"] = [list(s) for s in strat]
         for nid, n in self.nodes.items():
             rec["nodes"][str(nid)] = {
                 "kind": n.get("kind"), "rows": n["rows"],

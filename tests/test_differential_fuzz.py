@@ -11,7 +11,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
-from cylon_tpu import Table
+from cylon_tpu import Table, config
 
 pytestmark = pytest.mark.slow
 
@@ -266,3 +266,62 @@ def test_string_key_compressed_differential(ctx4, seed, monkeypatch):
     assert list(got["s"]) == list(gg["s"])
     np.testing.assert_allclose(got["sum_v"], gg["sum_v"], rtol=1e-9)
     np.testing.assert_array_equal(got["count_v"], gg["count_v"])
+
+
+@pytest.mark.parametrize("seed", SEEDS[:6])
+def test_tiny_dimension_broadcast_differential(ctx4, seed, monkeypatch):
+    """Adaptive broadcast-hash join over a tiny dimension side (the
+    shape the rule exists for) vs the pandas merge oracle — random
+    fact cardinality, dangling negative keys, NaN payloads."""
+    monkeypatch.setenv("CYLON_TPU_PLAN_ADAPTIVE", "1")
+    rng = np.random.default_rng(8000 + seed)
+    n = int(rng.integers(64, 400))
+    card = int(rng.integers(2, 24))
+    fact = pd.DataFrame({"k": rng.integers(-4, card, n).astype(np.int64),
+                         "v": rng.random(n)})
+    if rng.random() < 0.5:
+        fact.loc[rng.random(n) < 0.2, "v"] = np.nan
+    dim = pd.DataFrame({"k": np.arange(card, dtype=np.int64),
+                        "w": rng.random(card)})
+    q = (_mk(fact, ctx4).plan()
+         .join(Table.from_pandas(dim, ctx=ctx4, capacity=64),
+               on="k", how="inner"))
+    assert "BROADCAST(k)" in q.explain()
+    got = q.execute().to_pandas()
+    g = fact.merge(dim, on="k", how="inner")
+    assert len(got) == len(g)
+    np.testing.assert_allclose(
+        np.sort(np.nan_to_num(got["v"].to_numpy(), nan=-7e9)),
+        np.sort(np.nan_to_num(g["v"].to_numpy(), nan=-7e9)), rtol=1e-12)
+    np.testing.assert_allclose(np.sort(got["w"].to_numpy()),
+                               np.sort(g["w"].to_numpy()), rtol=1e-12)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:6])
+def test_zipfian_salted_nunique_differential(ctx4, seed, monkeypatch,
+                                             tmp_path):
+    """Skew-salted NUNIQUE vs the pandas oracle: a profiled run seeds
+    the statistics catalog (the salt rule only fires on observed skew),
+    then the salted plan must agree exactly with pandas AND with its
+    own unsalted run."""
+    monkeypatch.setenv("CYLON_TPU_STATS_DIR", str(tmp_path))
+    rng = np.random.default_rng(9000 + seed)
+    n = int(rng.integers(200, 500))
+    df = pd.DataFrame(
+        {"k": (np.minimum(rng.zipf(1.3, n), 40) - 1).astype(np.int64),
+         "u": rng.integers(0, 60, n).astype(np.int64)})
+    q = _mk(df, ctx4).plan().groupby(["k"], {"u": ["nunique"]})
+    with config.knob_env(CYLON_TPU_PLAN_ADAPTIVE="0",
+                         CYLON_TPU_PROFILE="1"):
+        plain = q.execute()
+    with config.knob_env(CYLON_TPU_PLAN_ADAPTIVE="1",
+                         CYLON_TPU_PLAN_SKEW_SALT="1.01"):
+        assert "salted x4" in q.explain()
+        salted = q.execute()
+    g = (df.groupby("k").agg(nunique_u=("u", "nunique")).reset_index())
+    got = salted.to_pandas().sort_values("k").reset_index(drop=True)
+    g = g.sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(got["k"], g["k"])
+    np.testing.assert_array_equal(got["nunique_u"], g["nunique_u"])
+    pd.testing.assert_frame_equal(
+        got, plain.to_pandas().sort_values("k").reset_index(drop=True))

@@ -526,3 +526,172 @@ def test_plan_result_partitioning_stamp(ctx4):
     q2 = out.plan().groupby(["k"], {"sum_u": "max"})
     phys = optimizer.optimize(q2, enabled=True)
     assert phys.root.ann["mode"] == "elided"
+
+
+# ---------------------------------------------------------------------------
+# adaptive planning (broadcast-hash joins + skew salting)
+# ---------------------------------------------------------------------------
+
+
+def _mk_fact(ctx, rng, n=960, nkeys=64, zipf=False):
+    if zipf:
+        k = (np.minimum(rng.zipf(1.3, n), nkeys) - 1).astype(np.int32)
+    else:
+        k = rng.integers(0, nkeys, n).astype(np.int32)
+    d = {"k": k, "v": rng.random(n).astype(np.float64),
+         "u": rng.integers(0, 97, n).astype(np.int64)}
+    return d, Table.from_numpy(list(d), list(d.values()), ctx=ctx)
+
+
+def _mk_dim(ctx, n=64):
+    d = {"k": np.arange(n, dtype=np.int32),
+         "w": (np.arange(n) % 7).astype(np.int64)}
+    return d, Table.from_numpy(list(d), list(d.values()), ctx=ctx)
+
+
+def test_adaptive_off_is_bitwise_pr9_planner(ctx4):
+    """ADAPTIVE off (default and explicit "0") must be byte-identical
+    to the PR-9 planner: same annotations, same fingerprint header, no
+    adaptive fields in explain."""
+    from cylon_tpu.plan import optimizer
+
+    rng = np.random.default_rng(31)
+    _, t = _mk_fact(ctx4, rng)
+    _, d = _mk_dim(ctx4)
+    q = t.plan().join(d, on="k", how="inner")
+    for mode in (None, "0", "auto"):
+        env = {} if mode is None else {"CYLON_TPU_PLAN_ADAPTIVE": mode}
+        with config.knob_env(**env):
+            phys = optimizer.optimize(q, enabled=True)
+            assert not phys.adaptive
+            assert phys.broadcast_joins == 0 and phys.keys_salted == 0
+            assert optimizer.strategy_spec(phys) == ()
+            assert q.fingerprint() == q.base_fingerprint()
+            assert "adaptive" not in q.explain()
+
+
+@pytest.mark.parametrize("world_fixture", ["local_ctx", "ctx2", "ctx4"])
+def test_adaptive_bit_identity_across_worlds(world_fixture, request):
+    """Adaptive-on, adaptive-off and eager must agree bit-for-bit on a
+    broadcast-shaped fact-dim join at every world size (broadcast is a
+    physical strategy, never a semantics change)."""
+    ctx = request.getfixturevalue(world_fixture)
+    rng = np.random.default_rng(32)
+    raw_f, t = _mk_fact(ctx, rng)
+    raw_d, d = _mk_dim(ctx)
+    q = (t.plan().join(d, on="k", how="inner")
+         .groupby(["l_k"], {"v": ["sum"], "w": ["max"]}))
+    with config.knob_env(CYLON_TPU_PLAN_ADAPTIVE="1"):
+        adaptive = q.execute()
+    with config.knob_env(CYLON_TPU_PLAN_ADAPTIVE="0"):
+        plain = q.execute()
+    with config.knob_env(CYLON_TPU_PLAN="0"):
+        eager = q.execute()
+    a = _sorted_pd(adaptive, ["l_k"])
+    pd.testing.assert_frame_equal(a, _sorted_pd(plain, ["l_k"]))
+    pd.testing.assert_frame_equal(a, _sorted_pd(eager, ["l_k"]))
+    j = pd.DataFrame(raw_f).merge(pd.DataFrame(raw_d), on="k")
+    exp = j.groupby("k").agg(sum_v=("v", "sum"),
+                             max_w=("w", "max")).reset_index()
+    assert len(a) == len(exp)
+    np.testing.assert_allclose(a["sum_v"], exp["sum_v"], rtol=1e-6)
+    np.testing.assert_array_equal(a["max_w"], exp["max_w"])
+
+
+def test_broadcast_join_one_gather_pin(ctx4):
+    """The broadcast arm moves the dimension with EXACTLY one packed
+    all_gather and zero all_to_all; the plan.broadcast_joins counter
+    and the explain renderer both report the decision."""
+    from cylon_tpu.analysis import budgets
+
+    rng = np.random.default_rng(33)
+    _, t = _mk_fact(ctx4, rng)
+    _, d = _mk_dim(ctx4)
+    q = t.plan().join(d, on="k", how="inner")
+    with config.knob_env(CYLON_TPU_PLAN_ADAPTIVE="1",
+                         CYLON_TPU_SHUFFLE="bucketed",
+                         CYLON_TPU_SHUFFLE_PACK="1"):
+        assert "BROADCAST(k)" in q.explain()
+        before = _counters(["plan.broadcast_joins"])
+        with budgets._LaunchMeter() as meter:
+            out = q.execute()
+        assert _deltas(before, ["plan.broadcast_joins"]) == {
+            "plan.broadcast_joins": 1}
+    assert meter.totals["all_gather"] == 1
+    assert meter.totals["all_to_all"] == 0
+    with config.knob_env(CYLON_TPU_PLAN="0"):
+        eager = q.execute()
+    pd.testing.assert_frame_equal(_sorted_pd(out, ["l_k", "v"]),
+                                  _sorted_pd(eager, ["l_k", "v"]))
+
+
+def test_salted_groupby_bit_identity_with_catalog(ctx4, tmp_path):
+    """Skew salting fires only on OBSERVED catalog skew (a profiled
+    prior run), costs one extra exchange, and is bit-identical to the
+    unsalted pipeline."""
+    rng = np.random.default_rng(34)
+    _, t = _mk_fact(ctx4, rng, zipf=True)
+    _, d = _mk_dim(ctx4)
+    q = (t.plan().join(d, on="k", how="inner")
+         .groupby(["l_k"], {"u": ["nunique"]}))
+    with config.knob_env(CYLON_TPU_STATS_DIR=str(tmp_path),
+                         CYLON_TPU_PLAN_ADAPTIVE="0",
+                         CYLON_TPU_PROFILE="1"):
+        plain = q.execute()
+    with config.knob_env(CYLON_TPU_STATS_DIR=str(tmp_path),
+                         CYLON_TPU_PLAN_ADAPTIVE="1",
+                         CYLON_TPU_PLAN_BROADCAST_BYTES="0",
+                         CYLON_TPU_PLAN_SKEW_SALT="1.2"):
+        txt = q.explain()
+        assert "salted x4" in txt and "catalog" in txt
+        before = _counters(["plan.keys_salted"])
+        salted = q.execute()
+        assert _deltas(before, ["plan.keys_salted"]) == {
+            "plan.keys_salted": 1}
+    pd.testing.assert_frame_equal(_sorted_pd(salted, ["l_k"]),
+                                  _sorted_pd(plain, ["l_k"]))
+
+
+def test_adaptive_salt_needs_catalog_evidence(ctx4, tmp_path):
+    """No catalog, no salt: with adaptive on but a cold stats dir the
+    skew estimate is (1.0, none) and the plan stays unsalted."""
+    rng = np.random.default_rng(35)
+    _, t = _mk_fact(ctx4, rng, zipf=True)
+    _, d = _mk_dim(ctx4)
+    q = (t.plan().join(d, on="k", how="inner")
+         .groupby(["l_k"], {"u": ["nunique"]}))
+    with config.knob_env(CYLON_TPU_STATS_DIR=str(tmp_path),
+                         CYLON_TPU_PLAN_ADAPTIVE="1",
+                         CYLON_TPU_PLAN_BROADCAST_BYTES="0",
+                         CYLON_TPU_PLAN_SKEW_SALT="1.2"):
+        txt = q.explain()
+        assert "salted x" not in txt and "keys_salted=0" in txt
+
+
+def test_catalog_strategy_folds_into_fingerprint(ctx4, tmp_path):
+    """The fingerprint must move with the STRATEGY, not just the query:
+    a catalog record that flips the broadcast decision flips the
+    fingerprint, while the base (catalog-key) fingerprint never moves."""
+    from cylon_tpu.obs import stats_catalog
+
+    rng = np.random.default_rng(36)
+    _, t = _mk_fact(ctx4, rng)
+    _, d = _mk_dim(ctx4)
+    q = t.plan().join(d, on="k", how="inner")
+    with config.knob_env(CYLON_TPU_STATS_DIR=str(tmp_path),
+                         CYLON_TPU_PLAN_ADAPTIVE="1"):
+        base = q.base_fingerprint()
+        fp_meta = q.fingerprint()          # cold catalog: metadata decides
+        assert fp_meta != base             # broadcast strategy folded in
+        # an agreeing catalog record (tiny observed rows) keeps the same
+        # decision and therefore the same fingerprint
+        stats_catalog.record(base, {"nodes": {"1": {"rows": 960},
+                                              "2": {"rows": 64}}})
+        assert q.base_fingerprint() == base
+        assert q.fingerprint() == fp_meta
+        # observed rows past the threshold on BOTH sides kill the
+        # broadcast: strategy empties, fingerprint returns to base
+        stats_catalog.record(base, {"nodes": {"1": {"rows": 10 ** 9},
+                                              "2": {"rows": 10 ** 9}}})
+        assert q.base_fingerprint() == base
+        assert q.fingerprint() == base

@@ -630,6 +630,62 @@ def test_cy108_only_fires_under_the_plan_package(tmp_path):
     assert "CY108" not in {f.rule for f in found}
 
 
+def test_cy112_stats_read_without_strategy_fold(tmp_path):
+    # ISSUE-17's bug class: an optimizer rule steering on catalog
+    # statistics while the plan fingerprint ignores the chosen strategy
+    # — a catalog update would flip the physical plan under an
+    # unchanged journal/serve cache key
+    found = _scan_plan(tmp_path, """\
+        def lookup_stats(plan):
+            return None
+
+        def _rule_broadcast_join(p):
+            return lookup_stats(p)
+
+        def plan_fingerprint(plan):
+            return hash(plan)  # strategy choice NOT folded
+        """, name="optimizer.py")
+    assert [(f.rule, f.line) for f in found if f.rule == "CY112"] \
+        == [("CY112", 4)]
+    assert "lookup_stats" in found[0].msg
+    assert "unchanged" in found[0].msg
+
+
+def test_cy112_strategy_folded_fingerprint_is_clean(tmp_path):
+    found = _scan_plan(tmp_path, """\
+        def strategy_spec(phys):
+            return ()
+
+        def lookup_stats(plan):
+            return None
+
+        def _rule_broadcast_join(p):
+            return lookup_stats(p)
+
+        def plan_fingerprint(plan, phys):
+            return hash((plan, strategy_spec(phys)))
+        """, name="optimizer.py")
+    assert "CY112" not in {f.rule for f in found}
+
+
+def test_cy112_missing_fingerprint_builder_fires(tmp_path):
+    # a plan package with NO fingerprint builder at all: the rule
+    # reading column statistics has nothing folding its choice
+    found = _scan_plan(tmp_path, """\
+        def _rule_salt_agg(p, stats):
+            return stats.column_stats("k")
+        """, name="optimizer.py")
+    assert any(f.rule == "CY112" for f in found)
+
+
+def test_cy112_only_fires_under_the_plan_package(tmp_path):
+    found = _scan(tmp_path, """\
+        def _rule_broadcast_join(p, stats):
+            return stats.column_stats("k")
+        """)
+    assert "CY112" not in {f.rule for f in found}
+
+
 _CY109_BUILDER = """\
     import jax
     from cylon_tpu import config

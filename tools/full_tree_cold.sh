@@ -1000,6 +1000,90 @@ if [ $rc -ne 0 ]; then
   echo "profiler smoke failed (rc=$rc); fix the query profiler before the full tree" >&2
   exit $rc
 fi
+# adaptive planner smoke (ISSUE-17): a Q10-shaped zipfian-customer-key
+# join + NUNIQUE on the world-8 CPU mesh, adaptive off first (profiled,
+# seeding the statistics catalog) then adaptive on against the SAME
+# catalog — the artifact JSON must record >=1 broadcast join, >=1
+# salted key, a >=2x shuffle.bytes_sent drop, and bit-identical results
+AD=$(mktemp -d /tmp/cylon_adaptive_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - "$AD" <<'PYEOF'
+import json, os, sys
+import numpy as np
+import pandas as pd
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cylon_tpu import Table, config
+from cylon_tpu.context import CylonContext, TPUConfig
+from cylon_tpu.obs import metrics
+
+td = sys.argv[1]
+ctx = CylonContext.InitDistributed(TPUConfig(world_size=8))
+rng = np.random.default_rng(42)
+n, nkeys = 1 << 14, 512
+# zipfian customer key: the Q10 shape where a few customers dominate
+ck = (np.minimum(rng.zipf(1.3, n), nkeys) - 1).astype(np.int32)
+orders = {"c_key": ck,
+          "o_total": rng.random(n).astype(np.float64),
+          "o_clerk": rng.integers(0, 997, n).astype(np.int64)}
+nation = {"c_key": np.arange(nkeys, dtype=np.int32),
+          "n_name": (np.arange(nkeys) % 25).astype(np.int64)}
+ot = Table.from_numpy(list(orders), list(orders.values()), ctx=ctx)
+nt = Table.from_numpy(list(nation), list(nation.values()), ctx=ctx)
+q = (ot.plan().join(nt, on="c_key", how="inner")
+     .groupby(["l_c_key"], {"o_clerk": ["nunique"]}))
+
+def run(adaptive, profile):
+    env = dict(CYLON_TPU_PLAN="1", CYLON_TPU_PLAN_ADAPTIVE=adaptive,
+               CYLON_TPU_STATS_DIR=os.path.join(td, "stats"),
+               CYLON_TPU_PLAN_SKEW_SALT="1.2")
+    if profile:
+        env["CYLON_TPU_PROFILE"] = "1"
+    with config.knob_env(**env):
+        before = {k: metrics.counter_value(k) for k in
+                  ("shuffle.bytes_sent", "plan.broadcast_joins",
+                   "plan.keys_salted")}
+        out = q.execute()
+        d = {k: metrics.counter_value(k) - v for k, v in before.items()}
+        return out, d
+
+base, d0 = run("0", True)   # profiled: seeds the statistics catalog
+adap, d1 = run("1", False)  # steers on the catalog it just observed
+a = adap.to_pandas().sort_values("l_c_key").reset_index(drop=True)
+b = base.to_pandas().sort_values("l_c_key").reset_index(drop=True)
+pd.testing.assert_frame_equal(a, b)  # bit-identical, float bits included
+rec = {"rows": int(adap.row_count),
+       "bytes_adaptive": int(d1["shuffle.bytes_sent"]),
+       "bytes_baseline": int(d0["shuffle.bytes_sent"]),
+       "ratio": d0["shuffle.bytes_sent"] / max(1, d1["shuffle.bytes_sent"]),
+       "plan": {"broadcast_joins": int(d1["plan.broadcast_joins"]),
+                "keys_salted": int(d1["plan.keys_salted"])},
+       "bit_identical": True}
+with open(f"{td}/adaptive_smoke.json", "w") as fh:
+    json.dump(rec, fh, indent=1, sort_keys=True)
+PYEOF
+rc=$?
+if [ $rc -eq 0 ]; then
+  python - "$AD" <<'PYEOF'
+import json, sys
+rec = json.load(open(f"{sys.argv[1]}/adaptive_smoke.json"))
+assert rec["plan"]["broadcast_joins"] >= 1, rec
+assert rec["plan"]["keys_salted"] >= 1, rec
+assert rec["ratio"] >= 2.0, rec
+assert rec["bit_identical"] is True, rec
+print(f"adaptive smoke ok: {rec['plan']['broadcast_joins']} broadcast "
+      f"join(s) + {rec['plan']['keys_salted']} salted key(s), "
+      f"{rec['bytes_baseline']} -> {rec['bytes_adaptive']} bytes sent "
+      f"({rec['ratio']:.1f}x), bit-identical to the PR-9 plan")
+PYEOF
+  rc=$?
+fi
+rm -rf "$AD"
+if [ $rc -ne 0 ]; then
+  echo "adaptive planner smoke failed (rc=$rc); fix the cost-based planner before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

@@ -160,6 +160,97 @@ def _compress_ab(n_rows: int) -> bool:
     return True
 
 
+def _adaptive_ab(n_rows: int) -> bool:
+    """ISSUE-17 A/B arms: the adaptive planner's two strategies against
+    the PR-9 plans they replace, on a mesh over every visible device.
+
+    Arm 1 (broadcast-vs-shuffle): a fact table joins a tiny dimension;
+    adaptive=on replicates the dimension with ONE all_gather while
+    adaptive=off pays two full exchanges.  Arm 2 (salted-vs-plain): a
+    zipfian-key NUNIQUE whose statistics catalog (seeded by one profiled
+    run into a throwaway dir) shows shard skew; adaptive=on salts the
+    repartition across value-hash buckets.  Both strategies are exact —
+    tests pin bit-identity — so the arms measure launches, bytes and
+    wall only."""
+    import tempfile
+
+    from cylon_tpu import Table, config
+    from cylon_tpu.context import CylonContext, TPUConfig
+    from cylon_tpu.obs import metrics as obs_metrics
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        # nonzero exit so the battery's `||` CPU-mesh fallback fires
+        print("adaptive-ab: needs >= 2 devices for a mesh; skipping",
+              flush=True)
+        return False
+    ctx = CylonContext.InitDistributed(TPUConfig(world_size=ndev))
+    r = np.random.default_rng(29)
+    wanted = ("shuffle.collective_launches", "shuffle.bytes_sent",
+              "plan.broadcast_joins", "plan.keys_salted")
+
+    def run_arms(q, arms, env):
+        for label, adaptive in arms:
+            with config.knob_env(CYLON_TPU_PLAN="1",
+                                 CYLON_TPU_PLAN_ADAPTIVE=adaptive, **env):
+                q.execute()  # warm the stage caches
+                best, deltas = None, None
+                for _ in range(REPS):
+                    before = dict(obs_metrics.snapshot()["counters"])
+                    t0 = time.perf_counter()
+                    out = q.execute()
+                    out.row_count  # force completion
+                    dt_s = time.perf_counter() - t0
+                    after = dict(obs_metrics.snapshot()["counters"])
+                    if best is None or dt_s < best:
+                        best = dt_s
+                        deltas = {k: after.get(k, 0) - before.get(k, 0)
+                                  for k in wanted}
+            print(f"adaptive-ab {label:16s} {best * 1e3:10.1f} ms  "
+                  f"launches={int(deltas['shuffle.collective_launches'])} "
+                  f"bytes_sent={int(deltas['shuffle.bytes_sent'])} "
+                  f"broadcasts={int(deltas['plan.broadcast_joins'])} "
+                  f"salted={int(deltas['plan.keys_salted'])}",
+                  flush=True)
+
+    # arm 1: fact x tiny dim — broadcast the dimension vs shuffle both
+    dim_rows = max(64, n_rows >> 8)
+    fact = {"k": r.integers(0, dim_rows, n_rows).astype(np.int32),
+            "v": r.random(n_rows).astype(np.float32)}
+    dim = {"k": np.arange(dim_rows, dtype=np.int32),
+           "w": r.random(dim_rows).astype(np.float32)}
+    ft = Table.from_numpy(list(fact), list(fact.values()), ctx=ctx)
+    dt_ = Table.from_numpy(list(dim), list(dim.values()), ctx=ctx)
+    qj = ft.plan().join(dt_, on="k", how="inner")
+    run_arms(qj, (("broadcast", "1"), ("shuffle", "0")),
+             {"CYLON_TPU_PLAN_BROADCAST_BYTES": str(64 << 20)})
+
+    # arm 2: zipfian-key join + NUNIQUE (the Q10 shape) — salted
+    # repartition vs plain.  The catalog is seeded OUTSIDE the timed
+    # arms by one profiled adaptive-off run (the salt rule only fires
+    # on OBSERVED skew; the shuffled join's output records it), and the
+    # broadcast threshold is zeroed in both timed arms so the delta
+    # below is the salt pipeline alone.
+    zk = (np.minimum(r.zipf(1.3, n_rows), dim_rows) - 1).astype(np.int32)
+    zt = Table.from_numpy(
+        ["k", "u"], [zk, r.integers(0, 1 << 16, n_rows).astype(np.int64)],
+        ctx=ctx)
+    qs = (zt.plan().join(dt_, on="k", how="inner")
+          .groupby(["l_k"], {"u": ["nunique"]}))
+    with tempfile.TemporaryDirectory() as stats_dir:
+        with config.knob_env(CYLON_TPU_PLAN="1",
+                             CYLON_TPU_PLAN_ADAPTIVE="0",
+                             CYLON_TPU_PROFILE="1",
+                             CYLON_TPU_STATS_DIR=stats_dir):
+            qs.execute()
+        run_arms(qs, (("salted", "1"), ("plain", "0")),
+                 {"CYLON_TPU_PLAN_SKEW_SALT": "1.2",
+                  "CYLON_TPU_PLAN_BROADCAST_BYTES": "0",
+                  "CYLON_TPU_STATS_DIR": stats_dir})
+    print("done", flush=True)
+    return True
+
+
 if "--plan-ab" in sys.argv:
     _ok = _plan_ab(_POS_ARGS and int(_POS_ARGS[0]) or (1 << 20))
     if _ok and obs_spans.events_enabled():
@@ -171,6 +262,13 @@ if "--compress-ab" in sys.argv:
     _ok = _compress_ab(_POS_ARGS and int(_POS_ARGS[0]) or (1 << 20))
     if _ok and obs_spans.events_enabled():
         _tp, _mp = obs_export.export_all(prefix="microbench_compress_ab")
+        print(f"trace artifact: {_tp}", flush=True)
+    sys.exit(0 if _ok else 3)
+
+if "--adaptive-ab" in sys.argv:
+    _ok = _adaptive_ab(_POS_ARGS and int(_POS_ARGS[0]) or (1 << 18))
+    if _ok and obs_spans.events_enabled():
+        _tp, _mp = obs_export.export_all(prefix="microbench_adaptive_ab")
         print(f"trace artifact: {_tp}", flush=True)
     sys.exit(0 if _ok else 3)
 

@@ -179,6 +179,19 @@ timeout 900 python tools/microbench.py 4194304 --compress-ab \
     > "$OUT/compress_ab.txt" 2>> "$OUT/compress_ab.log"
 log "compress A/B rc=$? $(head -c 200 "$OUT/compress_ab.txt" 2>/dev/null)"
 
+log "7g/9 adaptive planner A/B (CYLON_TPU_PLAN_ADAPTIVE)"
+# Tentpole knob (ISSUE 17): broadcast-vs-shuffle and salted-vs-plain
+# arms — wall + collective launches + bytes_sent per arm.  The
+# launch-count and wire-byte savings are ICI effects, so the real
+# accelerator mesh is the verdict when the tunnel is up; the CPU-mesh
+# fallback records the same exact arms so every round carries the A/B.
+timeout 900 python tools/microbench.py 4194304 --adaptive-ab \
+    > "$OUT/adaptive_ab.txt" 2> "$OUT/adaptive_ab.log" \
+  || JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 900 python tools/microbench.py 262144 --adaptive-ab \
+    > "$OUT/adaptive_ab.txt" 2>> "$OUT/adaptive_ab.log"
+log "adaptive A/B rc=$? $(head -c 200 "$OUT/adaptive_ab.txt" 2>/dev/null)"
+
 log "8/9 kernel smoke"
 timeout 2400 python tpu_smoke.py > "$OUT/smoke.json" 2> "$OUT/smoke.log"
 log "smoke rc=$?"
