@@ -48,6 +48,15 @@ def main(argv=None) -> int:
     ap.add_argument("--write-budgets", action="store_true",
                     help="regenerate cylon_tpu/analysis/budgets/*.json "
                          "from a live trace (commit the result)")
+    ap.add_argument("--lockgraph", action="store_true",
+                    help="also run the elastic/serve smoke under the "
+                         "runtime lock recorder and check the observed "
+                         "lock-order edges against the committed golden "
+                         "and the static lock graph")
+    ap.add_argument("--write-lockgraph", action="store_true",
+                    help="regenerate cylon_tpu/analysis/lockgraph/"
+                         "lock_order.json from a recorded smoke run "
+                         "(commit the result)")
     ap.add_argument("--knobs", action="store_true",
                     help="print the authoritative CYLON_TPU_* knob table "
                          "and exit")
@@ -73,7 +82,7 @@ def main(argv=None) -> int:
 
     findings = []
     paths = args.paths
-    if not paths and not args.write_budgets:
+    if not paths and not (args.write_budgets or args.write_lockgraph):
         paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
     if paths:
         findings.extend(scan_paths(paths))
@@ -87,6 +96,18 @@ def main(argv=None) -> int:
         from .budgets import check_budgets
 
         findings.extend(check_budgets())
+
+    if args.write_lockgraph or args.lockgraph:
+        from .locks import (check_lockgraph, smoke_observed, static_edges,
+                            write_lockgraph)
+
+        static = static_edges()
+        observed = smoke_observed()
+        if args.write_lockgraph:
+            print(f"wrote {write_lockgraph(observed, static)}",
+                  file=sys.stderr)
+        else:
+            findings.extend(check_lockgraph(observed, static))
 
     if args.json:
         print(json.dumps([f.__dict__ for f in findings], indent=2))

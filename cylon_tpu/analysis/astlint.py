@@ -48,8 +48,18 @@ RULES: Dict[str, str] = {
              "membership lock is held",
     "CY112": "optimizer rule reads observed statistics but no plan "
              "fingerprint builder folds the strategy choice",
+    "CY113": "lock-order cycle / inconsistent pairwise lock ordering "
+             "(potential deadlock)",
+    "CY114": "blocking primitive (sleep / Thread.join / Condition.wait "
+             "on the wrong lock / unbounded queue.get) reachable while "
+             "a lock is held",
+    "CY115": "instance attribute written from >=2 thread roots with no "
+             "common guarding lock",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
+    "CY203": "missing lock-order golden file",
+    "CY204": "observed lock-order edge not covered by the golden or the "
+             "static lock graph",
 }
 
 #: files allowed to read os.environ directly: the registry itself, and
@@ -1348,6 +1358,12 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         for f in mod.funcs.values():
             if f.qual in traced:
                 _Taint(f, mod, mod.findings).run()
+
+    # level 3 (concurrency): lock-order graph, blocking-under-lock,
+    # cross-thread shared state — class-aware, so it runs its own pass
+    from . import locks as _locks
+
+    _locks.check_concurrency(modules)
 
     out: List[Finding] = []
     for mod in modules:

@@ -586,6 +586,13 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
        help="Sleep injected by the `delay` fault kind (a seeded straggler "
             "for skew-attribution tests: the process keeps heartbeating "
             "but arrives late at every collective)."),
+    _K("CYLON_TPU_LOCK_RECORD", "bool", False, RUNTIME,
+       accessors=("cylon_tpu.analysis.locks.record_enabled",),
+       help="Enable the runtime lock-acquisition recorder (cylint Level 3): "
+            "threading.Lock/RLock/Condition factories are wrapped so every "
+            "held->acquired edge is captured and checked against the "
+            "committed lock-order golden.  Test/CI instrumentation only; "
+            "never enabled in production paths."),
     _K("CYLON_TEST_NO_COMPILE_CACHE", "bool", False, RUNTIME,
        help="Disable the per-backend persistent XLA compile cache.  Read "
             "directly in utils/compile_cache.py (the enabler must work "

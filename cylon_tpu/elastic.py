@@ -585,7 +585,8 @@ class Coordinator:
         """Simulated coordinator crash (the ``coordinator_loss`` fault
         kind): drop the socket without ceremony — agents must detect the
         silence and fail clean."""
-        self.died = True
+        with self._lock:  # restart() clears the flag under the same lock
+            self.died = True
         log.warning("elastic: coordinator dying (injected coordinator_loss)")
         self.stop()
 
@@ -879,7 +880,9 @@ class Coordinator:
         bind_deadline = time.monotonic() + max(5.0, 2 * self.timeout)
         while True:
             try:
-                self._server = control.JsonServer(
+                # bind OUTSIDE the membership lock (the retry sleeps);
+                # only the publication of the bound server takes it
+                srv = control.JsonServer(
                     self._handle, host=host, port=port,
                     max_line=self.SERVER_MAX_LINE)
                 break
@@ -887,7 +890,9 @@ class Coordinator:
                 if time.monotonic() >= bind_deadline:
                     raise
                 time.sleep(0.05)
-        self._server.start()
+        with self._lock:
+            self._server = srv
+        srv.start()
         obs_spans.instant("coord.restart", incarnation=inc, epoch=epoch,
                           members=members, down_s=down_s)
         obs_metrics.counter_add("coord.restart")
@@ -1385,8 +1390,12 @@ class Agent:
         # handle must be caught HERE, where it costs the metrics, not
         # later in control.request where the TypeError would escape
         # _beat's OSError handling and kill the heartbeat thread.
-        ship = self._beat_n % max(1, self.METRICS_EVERY_BEATS) == 0
-        self._beat_n += 1
+        # the cadence counter is bumped by the beat thread AND by the
+        # immediate caller-side heartbeats (join, telemetry attach) — an
+        # unguarded += here is a lost-update race on the ship cadence
+        with self._lock:
+            ship = self._beat_n % max(1, self.METRICS_EVERY_BEATS) == 0
+            self._beat_n += 1
         if ship:
             try:
                 m = obs_metrics.snapshot()

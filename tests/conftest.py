@@ -57,6 +57,25 @@ def pytest_configure(config):
 
 
 @pytest.fixture(scope="session", autouse=True)
+def _lock_record_session():
+    """CYLON_TPU_LOCK_RECORD=1: wrap the whole test session in the
+    cylint Level-3 lock recorder — every in-process lock created by the
+    elastic/serve/router suites records its ordering, and a held->
+    acquired edge missing from the committed lock-order golden fails the
+    session (CY204) the same way the --lockgraph smoke would."""
+    from cylon_tpu.analysis import locks
+
+    if not locks.record_enabled():
+        yield
+        return
+    rec = locks.LockRecorder()
+    with locks.record_locks(rec):
+        yield
+    found = locks.check_lockgraph(rec.observed())
+    assert not found, "\n".join(f.render() for f in found)
+
+
+@pytest.fixture(scope="session", autouse=True)
 def _trace_dir_isolation(tmp_path_factory):
     """Point CYLON_TPU_TRACE_DIR at a session tmp dir unless the caller
     set one: the flight recorder (obs.fleet) auto-dumps on classified

@@ -25,11 +25,15 @@ if [ "$live" != "$PINNED_JAX $PINNED_JAXLIB" ]; then
   echo "         (SIGSEGV originally observed under $CRASH_OBSERVED_UNDER;" >&2
   echo "         re-run this repro and tools/segv_canary.sh, then update the pin)" >&2
 fi
-# static-analysis gate first: trace-safety rules + the jaxpr collective
-# budgets are pure-CPU and catch a 1 -> 13 collective regression in
-# seconds, before the 4-hour tree gets a chance to
+# static-analysis gate first: trace-safety rules, the Level-3
+# concurrency rules (CY113/CY114/CY115) + the jaxpr collective budgets
+# are pure-CPU and catch a 1 -> 13 collective regression in seconds,
+# before the 4-hour tree gets a chance to; --lockgraph additionally
+# drives one elastic + one router smoke under the runtime lock
+# recorder and fails on any observed lock-order edge missing from the
+# committed golden (regenerate with --write-lockgraph after review)
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python -m cylon_tpu.analysis cylon_tpu --budgets || {
+    python -m cylon_tpu.analysis cylon_tpu --budgets --lockgraph || {
   rc=$?
   echo "cylint failed (rc=$rc); fix findings before the full tree" >&2
   exit $rc
