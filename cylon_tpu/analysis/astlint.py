@@ -55,6 +55,9 @@ RULES: Dict[str, str] = {
              "a lock is held",
     "CY115": "instance attribute written from >=2 thread roots with no "
              "common guarding lock",
+    "CY116": "stream-package reader decodes a persisted partial-"
+             "aggregate spill without validating the state schema "
+             "version first",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
     "CY203": "missing lock-order golden file",
@@ -172,6 +175,20 @@ STRATEGY_FOLD_TOKEN = "strategy_spec"
 #: cover it (it is data, not a knob), hence key-complete builders are
 #: NOT exempt.  Matched by final call identifier.
 REALIZED_LAYOUT_PRODUCERS = frozenset({"build_spec", "estimate_spec"})
+
+#: the streaming layer's persisted-state decode discipline, for CY116:
+#: a checksum proves the BYTES of a partial-aggregate spill are intact,
+#: but not that their MEANING held — the partial column order, the
+#: identity-fill convention and the combine layout are an on-disk
+#: contract (stream/state.py), and a layout change silently misreading
+#: an old spill corrupts a refresh no checksum can catch.  So any
+#: stream-package function that lexically performs a spill decode
+#: (``load_pass`` / ``frame_from_ipc_bytes``) must ALSO lexically call
+#: the version gate — validation at a distance (a caller checked) is
+#: exactly the refactoring hazard the rule exists to kill.
+STREAM_MODULE_PREFIX = "cylon_tpu.stream"
+STATE_DECODE_NAMES = frozenset({"load_pass", "frame_from_ipc_bytes"})
+STATE_VERSION_GUARD = "require_state_version"
 
 _SUPPRESS_RE = re.compile(
     r"#\s*cylint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--\s*(\S.*))?\s*$")
@@ -1315,6 +1332,39 @@ def _check_adaptive_fingerprint(prog: _Program, mod: _Module) -> None:
             "stop steering on catalog statistics in this rule"))
 
 
+def _check_state_version(prog: _Program, mod: _Module) -> None:
+    """CY116: a stream-package function (module under
+    ``cylon_tpu.stream``) that lexically decodes a persisted spill
+    (``load_pass`` / ``frame_from_ipc_bytes`` in its own calls) without
+    lexically calling ``require_state_version``.
+
+    The invariant: persisted partial-aggregate state is a layout
+    contract, not just bytes — the spill checksum (durable.py) proves
+    integrity, the schema version proves INTERPRETABILITY.  Requiring
+    the gate in the SAME function as the decode (not merely reachable
+    from it) is deliberate: a reachable-guard rule goes quiet when a
+    distant caller validates, and then a refactor that lifts the decode
+    into a new helper silently drops the guard.  Lexical pairing makes
+    the discipline survive refactors."""
+    if not mod.name.startswith(STREAM_MODULE_PREFIX):
+        return
+    for f in mod.funcs.values():
+        decodes = f.call_finals & STATE_DECODE_NAMES
+        if not decodes:
+            continue
+        if STATE_VERSION_GUARD in f.call_finals:
+            continue
+        mod.findings.append(Finding(
+            "CY116", mod.path, f.lineno,
+            f"`{f.qual.rsplit('.', 1)[-1]}` decodes persisted stream "
+            f"state ({', '.join(sorted(decodes))}) without validating "
+            f"the state schema version — a combine-layout change would "
+            f"silently misread old spills (intact bytes, moved meaning)",
+            f"call stream.state.{STATE_VERSION_GUARD}(...) on the "
+            f"spill's pass provenance in this function, BEFORE the "
+            f"decode"))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -1355,6 +1405,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_lock_held_blocking(prog, mod)
         _check_plan_fingerprint(prog, mod)
         _check_adaptive_fingerprint(prog, mod)
+        _check_state_version(prog, mod)
         for f in mod.funcs.values():
             if f.qual in traced:
                 _Taint(f, mod, mod.findings).run()

@@ -213,6 +213,21 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
                   "cylon_tpu.precision.count_acc"),
        help="Accumulator widths for sums/stats: wide (f64/i64) vs narrow "
             "(f32/i32-native); auto narrows on TPU-family backends."),
+    _K("CYLON_TPU_STREAM_BATCH_CAP", "int", 0, TRACE, cache_key=True,
+       accessors=("cylon_tpu.stream.incremental.batch_cap",),
+       help="Fixed device capacity per streaming micro-batch (rows); 0 "
+            "(default) derives pow2ceil(batch rows) per batch.  Trace-"
+            "scope cache key: padded batch shape is part of the stream "
+            "kernel's traced program AND of the persisted-state "
+            "namespace — flipping it must re-derive state from the "
+            "batch log, never combine across capacity regimes."),
+    _K("CYLON_TPU_STREAM_STATE_CAP", "int", 0, TRACE, cache_key=True,
+       accessors=("cylon_tpu.stream.incremental.state_cap",),
+       help="Floor for the incremental group-by's persisted-state group "
+            "capacity (rows); 0 (default) derives from the first "
+            "batch's group count.  State still regrows by the "
+            "deterministic overflow-restart rule.  Trace-scope cache "
+            "key for the same reason as CYLON_TPU_STREAM_BATCH_CAP."),
     # -- plan-scope / runtime knobs ----------------------------------------
     _K("CYLON_TPU_SHUFFLE", "enum", "auto", RUNTIME,
        choices=("ragged", "bucketed", "auto"),

@@ -71,6 +71,16 @@ log = logging.getLogger("cylon_tpu")
 
 MANIFEST = "MANIFEST.jsonl"
 
+#: marker file (PR 19) exempting a run dir from the size-cap LRU GC:
+#: live stream state (a StreamTable's batch log, a standing query's
+#: partial-aggregate spills) is consulted on EVERY refresh, and evicting
+#: it between refreshes silently degrades each refresh to a full
+#: recompute — so a pinned run is skipped by ``gc_journal`` even when it
+#: is the LRU victim.  Honored UNDER the GC lease (re-checked per victim
+#: immediately before eviction, like the freshen re-read), so a pin
+#: racing a concurrent replica's sweep still protects the run.
+PINNED = "PINNED"
+
 #: advisory cross-process GC lease file (journal root); a GC holding a
 #: lease younger than the TTL excludes every other replica's GC
 GC_LOCK = "GC_LOCK"
@@ -378,12 +388,19 @@ class RunJournal:
         return (int(level), int(part)) in self._passes
 
     def record_pass(self, level: int, part: int, frame: Dict[str, np.ndarray],
-                    rows: int) -> bool:
+                    rows: int,
+                    provenance: Optional[dict] = None) -> bool:
         """Spill one completed pass's host frame and commit it to the
         manifest; True iff the pass is now durably journaled.  Spill/
         serialize failures disable journaling for the rest of the run
         (counted, warned) — durability is best-effort and must never
-        fail a pass that already computed."""
+        fail a pass that already computed.
+
+        ``provenance`` (PR 19): an optional JSON-safe dict folded into
+        the manifest pass entry — the streaming layer records each
+        micro-batch's id, row count, content fingerprint and state
+        schema version here, so a resumed process can audit WHAT a pass
+        holds without decoding the spill (``pass_provenance``)."""
         if self._spill_disabled:
             return False
         from . import resilience
@@ -429,6 +446,8 @@ class RunJournal:
             entry = {"kind": "pass", "level": int(level), "part": int(part),
                      "rows": int(rows), "file": name, "sha256": digest,
                      "bytes": len(payload)}
+            if provenance:
+                entry["provenance"] = dict(provenance)
             if self.world is not None:
                 entry["world"] = int(self.world)
             if self.epoch is not None:
@@ -545,6 +564,48 @@ class RunJournal:
         obs_metrics.counter_add("durable.spills_rejected")
         return None
 
+    def pass_provenance(self, level: int, part: int) -> Optional[dict]:
+        """The ``provenance`` dict a pass was recorded with, or None when
+        the pass is absent or carried none.  Manifest-only (no spill
+        read): the streaming layer's watermark replay and schema-version
+        gate both decide from provenance before any decode."""
+        entry = self._passes.get((int(level), int(part)))
+        if entry is None:
+            return None
+        return entry.get("provenance")
+
+    def parts_at_level(self, level: int) -> List[int]:
+        """Sorted part ids journaled at ``level`` — the streaming
+        layer's batch inventory (batch i == pass (0, i))."""
+        return sorted(p for (lv, p) in self._passes if lv == int(level))
+
+    # -- GC pinning (PR 19: live stream state) ----------------------------
+
+    def pin(self) -> bool:
+        """Exempt this run from ``gc_journal`` LRU eviction: write an
+        fsync'd ``PINNED`` marker in the run dir.  Best-effort like
+        every other journal write; True iff the marker is durable."""
+        path = os.path.join(self.dir, PINNED)
+        try:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps({"pid": os.getpid(),
+                                     "fingerprint": self.fingerprint}) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+        except OSError as e:
+            log.warning("durable: cannot pin run %s (%s: %s)",
+                        self.fingerprint[:12], type(e).__name__, e)
+            return False
+        return True
+
+    def unpin(self) -> None:
+        """Re-admit this run to LRU eviction (stream closed/retired)."""
+        with contextlib.suppress(OSError):
+            os.remove(os.path.join(self.dir, PINNED))
+
+    def pinned(self) -> bool:
+        return os.path.exists(os.path.join(self.dir, PINNED))
+
     # -- quarantine record ------------------------------------------------
 
     def record_quarantine(self, level: int, part: int, code: str,
@@ -592,7 +653,8 @@ def scan_runs(root: Optional[str] = None) -> List[dict]:
     """Inventory of the journal root for GC/cache introspection: one dict
     per run dir — ``fingerprint``, ``bytes`` (all files), ``mtime`` (the
     manifest's, the LRU clock), ``complete`` (a ``done`` manifest record
-    exists) — sorted least-recently-used first.  Pure filesystem walk;
+    exists), ``pinned`` (a ``PINNED`` marker exempts the run from LRU
+    eviction) — sorted least-recently-used first.  Pure filesystem walk;
     unreadable entries are skipped (a racing eviction is not an error)."""
     root = durable_dir() if root is None else root
     out: List[dict] = []
@@ -622,7 +684,8 @@ def scan_runs(root: Optional[str] = None) -> List[dict]:
         except OSError:
             continue
         out.append({"fingerprint": name, "dir": d, "bytes": total,
-                    "mtime": mtime, "complete": complete})
+                    "mtime": mtime, "complete": complete,
+                    "pinned": os.path.exists(os.path.join(d, PINNED))})
     out.sort(key=lambda r: (r["mtime"], r["fingerprint"]))
     return out
 
@@ -708,7 +771,10 @@ def gc_journal(root: Optional[str] = None,
     victim's manifest mtime is RE-READ immediately before eviction — the
     CoordLog ownership-re-read pattern — so a run that a third replica
     opened or replayed (freshening its LRU clock) after our scan is
-    skipped this round instead of half-evicted under a reader."""
+    skipped this round instead of half-evicted under a reader.  A
+    ``PINNED`` marker (live stream state, PR 19) is likewise re-checked
+    per victim UNDER the lease: a pinned run is never evicted no matter
+    how cold its LRU clock (``durable.gc_skipped_pinned``)."""
     root = durable_dir() if root is None else root
     cap = cap_bytes() if cap is None else max(0, int(cap))
     if not root or cap <= 0:
@@ -728,6 +794,12 @@ def gc_journal(root: Optional[str] = None,
             if total - freed <= cap:
                 break
             if r["dir"] == live:
+                continue
+            if os.path.exists(os.path.join(r["dir"], PINNED)):
+                # re-checked under the lease, not trusted from the scan:
+                # a stream that pinned its state after our inventory
+                # must still survive this sweep
+                obs_metrics.counter_add("durable.gc_skipped_pinned")
                 continue
             manifest = os.path.join(r["dir"], MANIFEST)
             try:

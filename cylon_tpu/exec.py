@@ -86,6 +86,12 @@ def _as_host_frame(obj) -> Tuple[List[str], Dict[str, np.ndarray]]:
                      f"expected DataFrame/dict/Table, got {type(obj)}")
 
 
+#: public name (PR 19): the streaming layer's ``StreamTable.append``
+#: accepts exactly the inputs the chunked engine does, through the same
+#: normalizer — the two can never disagree on what a "frame" is
+as_host_frame = _as_host_frame
+
+
 _U63 = np.uint64(1) << np.uint64(63)
 
 
@@ -347,6 +353,13 @@ def _numeric_fill(arr: np.ndarray, pop: AggOp, src_dtype) -> np.ndarray:
         out = out.astype(np.float64 if pop in (AggOp.SUM, AggOp.SUMSQ)
                          else np.int64)
     return out
+
+
+#: public name (PR 19): the streaming layer reloads persisted partial-
+#: aggregate spills through the same identity-refill as the chunked
+#: combine, so a stream state roundtrip and a cross-pass combine can
+#: never disagree on what an all-null partial means
+numeric_fill = _numeric_fill
 
 
 # ---------------------------------------------------------------------------

@@ -197,8 +197,11 @@ def _append_build_info(lines: List[str], typed: Dict[str, str],
 #: counters a scrape must ALWAYS see, zero-valued before first increment:
 #: the tail-retention pair — a dashboard alerting on retention behavior
 #: must be able to distinguish "no requests closed yet" (both zero) from
-#: "the counters don't exist" (a broken deploy)
-_ALWAYS_COUNTERS = ("trace.tail_kept", "trace.tail_dropped")
+#: "the counters don't exist" (a broken deploy) — and the streaming
+#: ingest pair (PR 19), for the same reason: an idle stream scrapes as
+#: zeros, a process without the stream subsystem is a broken deploy
+_ALWAYS_COUNTERS = ("trace.tail_kept", "trace.tail_dropped",
+                    "stream.batches_appended", "stream.rows_delta")
 
 
 def _with_always_counters(snap: Dict) -> Dict:

@@ -61,6 +61,37 @@ def explain(plan, optimized: Optional[bool] = None,
     return "\n".join(lines)
 
 
+def explain_refresh(info: dict) -> str:
+    """Render a streaming refresh plan (PR 19) from its ``describe()``
+    dict — a plain dict on purpose, so the plan package never imports
+    the stream package.  States the incremental-vs-full decision and
+    WHY, the same contract ``explain()`` has for shuffle elision."""
+    mode = str(info.get("mode", "full")).upper()
+    lines = [f"refresh [stream={info.get('stream')} "
+             f"watermark={info.get('watermark')} mode={mode} "
+             f"durable={'on' if info.get('durable') else 'off'}]",
+             f"  {mode}: {info.get('reason', '-')}"]
+    if info.get("kind") == "groupby":
+        lines.append(
+            f"  groupby [{', '.join(info.get('by', ()))}] "
+            f"{', '.join(info.get('aggs', ()))}  "
+            f"[{info.get('partials', 0)} persisted partial columns]")
+        if mode == "INCREMENTAL":
+            lines.append("  delta batches -> partial kernel -> one jitted "
+                         "combine with persisted state -> finalize "
+                         "(unchanged)")
+        else:
+            lines.append("  frozen batches 0..N-1 -> concat -> one local "
+                         "group-by (no reusable partial state)")
+    elif info.get("kind") == "join":
+        lines.append(
+            f"  join {info.get('how')} on {', '.join(info.get('on', ()))}  "
+            f"[dim: {info.get('dim_rows')} rows, broadcast once]")
+        lines.append("  delta fact batches probe the static dim; committed "
+                     "probe outputs replay from the journal")
+    return "\n".join(lines)
+
+
 def _header(phys: optimizer.PhysPlan) -> str:
     # adaptive fields render ONLY when the adaptive planner ran — the
     # default header stays byte-identical to the PR-9 renderer

@@ -141,7 +141,7 @@ _PER_REPLICA_ZERO = {"served": 0, "shed": 0, "rerouted_away": 0,
 #: importing serve here would drag the jax engine into the router
 #: process, and CY110's host-only guarantee with it.
 HEDGE_SAFE_OPS = frozenset({"join", "join_groupby", "groupby", "sort",
-                            "plan"})
+                            "plan", "refresh"})
 
 # breaker states — also the `router.breaker_state[replica=N]` gauge
 # values (0 scrapes as healthy, higher is worse)

@@ -134,7 +134,7 @@ def _slo_key(kind: str, tenant: str) -> str:
 
 #: ops the service admits — each maps to a chunked-engine entry point
 #: accepting ``ctx=`` and ``pass_guard=`` (the cancellation hook)
-OPS = ("join", "join_groupby", "groupby", "sort", "plan")
+OPS = ("join", "join_groupby", "groupby", "sort", "plan", "refresh")
 
 
 def _run_plan(plan, *, ctx=None, pass_guard=None, **kw):
@@ -149,12 +149,29 @@ def _run_plan(plan, *, ctx=None, pass_guard=None, **kw):
     return plan_mod.run_service(plan, ctx=ctx, pass_guard=pass_guard, **kw)
 
 
+def _run_refresh(query_or_spec, *args, ctx=None, pass_guard=None, **kw):
+    """Serve runner for streaming refreshes (``submit(tenant, "refresh",
+    query_or_spec)``): accepts a built stream query object or its JSON
+    spec (a replica sharing the durable dir rebuilds the stream from the
+    manifest, which is what makes the op router-routable).  Idempotent
+    by construction — the result fingerprint folds the stream's high-
+    watermark batch id, so a refresh with no new batches is a pure
+    cache hit and a hedged duplicate lands on the same journal entry.
+    Lazy import: a serve-only process that never streams should not pay
+    for the stream package."""
+    from .. import stream as stream_mod
+
+    return stream_mod.run_refresh(query_or_spec, *args, ctx=ctx,
+                                  pass_guard=pass_guard, **kw)
+
+
 _RUNNERS = {
     "join": exec_mod.chunked_join,
     "join_groupby": exec_mod.chunked_join_groupby_tables,
     "groupby": exec_mod.chunked_groupby,
     "sort": exec_mod.chunked_sort,
     "plan": _run_plan,
+    "refresh": _run_refresh,
 }
 
 

@@ -686,6 +686,73 @@ def test_cy112_only_fires_under_the_plan_package(tmp_path):
     assert "CY112" not in {f.rule for f in found}
 
 
+def _scan_stream(tmp_path, src, name="loader.py"):
+    """CY116 fixtures must live under cylon_tpu/stream/ for the module
+    name to resolve into the streaming namespace."""
+    d = tmp_path / "cylon_tpu" / "stream"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(src))
+    return astlint.scan_paths([str(p)])
+
+
+def test_cy116_decode_without_version_gate(tmp_path):
+    # ISSUE-19's bug class: a combine-layout change silently misreading
+    # old partial-aggregate spills — the checksum proves the bytes, the
+    # schema version proves the MEANING, and this reader skips the gate
+    found = _scan_stream(tmp_path, """\
+        def load_state(journal, part):
+            frame, rows = journal.load_pass(0, part)
+            return frame, rows
+        """)
+    assert [(f.rule, f.line) for f in found if f.rule == "CY116"] \
+        == [("CY116", 1)]
+    assert "load_pass" in found[0].msg
+    assert "schema version" in found[0].msg
+
+
+def test_cy116_gated_decode_is_clean(tmp_path):
+    found = _scan_stream(tmp_path, """\
+        from cylon_tpu.stream.state import require_state_version
+
+        def load_state(journal, part):
+            require_state_version(journal.pass_provenance(0, part))
+            frame, rows = journal.load_pass(0, part)
+            return frame, rows
+        """)
+    assert "CY116" not in {f.rule for f in found}
+
+
+def test_cy116_gate_at_a_distance_still_fires(tmp_path):
+    # the refactoring hazard the rule exists to kill: the CALLER
+    # validates, then the decode is lifted into a helper and the guard
+    # silently stops covering it — lexical pairing is the discipline
+    found = _scan_stream(tmp_path, """\
+        from cylon_tpu.stream.state import require_state_version
+
+        def refresh(journal, part):
+            require_state_version(journal.pass_provenance(0, part))
+            return _decode(journal, part)
+
+        def _decode(journal, part):
+            from cylon_tpu.io.arrow_io import frame_from_ipc_bytes
+            return frame_from_ipc_bytes(journal.read_spill(part))
+        """)
+    assert [(f.rule, f.line) for f in found if f.rule == "CY116"] \
+        == [("CY116", 7)]
+    assert "frame_from_ipc_bytes" in found[0].msg
+
+
+def test_cy116_only_fires_under_the_stream_package(tmp_path):
+    # durable.py itself (and every non-stream caller of load_pass) is
+    # out of scope: the version field is a STREAM-layer contract
+    found = _scan(tmp_path, """\
+        def resume(journal):
+            return journal.load_pass(0, 0)
+        """)
+    assert "CY116" not in {f.rule for f in found}
+
+
 _CY109_BUILDER = """\
     import jax
     from cylon_tpu import config

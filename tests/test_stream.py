@@ -1,0 +1,359 @@
+"""Streaming ingestion (PR 19): StreamTable append/watermark contract,
+incremental group-by/join refresh, durable crash-resume, GC pinning,
+and the serve-layer ``refresh`` op.
+
+The load-bearing assertion everywhere: a refresh at watermark N is
+bit-identical to a cold full recompute over the frozen concatenation of
+batches 0..N-1 (``recompute_cold``), pinned across worlds 1/2/4 and
+across a kill -9 mid-append — while executing ONLY the delta (obs
+counters: ``parts_run``/``partial_rows`` bounded by the batch,
+``plan_cache.miss == 0`` on the reused plan, ``stream.rows_delta`` ==
+batch rows).
+"""
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cylon_tpu import config, durable
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.status import CylonError
+from cylon_tpu.stream import (GroupByQuery, JoinQuery, StreamTable,
+                              run_refresh)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _digest(frame) -> str:
+    """Byte-exact digest of a host frame: names, dtypes, values."""
+    h = hashlib.sha256()
+    for name in frame:
+        a = np.asarray(frame[name])
+        h.update(f"{name}|{a.dtype}|{a.shape}".encode())
+        h.update(repr(a.tolist()).encode() if a.dtype == object
+                 else a.tobytes())
+    return h.hexdigest()
+
+
+def _assert_bit_identical(got, expected):
+    assert set(got) == set(expected), (set(got), set(expected))
+    for k in expected:
+        a, b = np.asarray(got[k]), np.asarray(expected[k])
+        assert a.dtype == b.dtype and a.shape == b.shape, \
+            (k, a.dtype, b.dtype, a.shape, b.shape)
+        if a.dtype == object:
+            assert a.tolist() == b.tolist(), k
+        else:
+            assert a.tobytes() == b.tobytes(), k
+
+
+def _batches(rows=16, n=3, seed=19):
+    rng = np.random.default_rng(seed)
+    return [{"k": rng.integers(0, 6, rows).astype(np.int64),
+             "v": rng.random(rows)} for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# append/watermark contract
+# ---------------------------------------------------------------------------
+
+def test_append_contract_validation():
+    s = StreamTable("contract")
+    with pytest.raises(CylonError):
+        s.append({})  # no columns
+    assert s.watermark == 0 and s.schema is None
+    s.append({"k": np.arange(3), "v": np.ones(3)})
+    assert s.watermark == 1 and s.schema == ("k", "v")
+    with pytest.raises(CylonError):  # ragged
+        s.append({"k": np.arange(3), "v": np.ones(2)})
+    with pytest.raises(CylonError):  # reshape
+        s.append({"k": np.arange(3), "x": np.ones(3)})
+    with pytest.raises(CylonError):  # query before schema exists
+        GroupByQuery(StreamTable("empty-one"), ["k"], {"v": "sum"})
+
+
+def test_idempotent_replay_after_reopen(tmp_path):
+    """Re-running the same append script against a journal that already
+    committed some batches converges on the identical log: committed
+    appends no-op, the first new batch lands at the watermark."""
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        b = _batches()
+        s = StreamTable("replay")
+        assert s.append(b[0]) == 0 and s.append(b[1]) == 1
+        # fresh handle, same journal: the script re-runs from the top
+        s2 = StreamTable("replay")
+        assert s2.watermark == 2
+        assert s2.append(b[0]) == 0  # replayed no-op
+        assert s2.append(b[1]) == 1  # replayed no-op
+        assert s2.watermark == 2
+        assert s2.append(b[2]) == 2  # genuinely new
+        assert s2.watermark == 3
+        assert s2.batch_rows() == [16, 16, 16]
+
+
+# ---------------------------------------------------------------------------
+# incremental group-by: delta-only + bit-identity, pinned across worlds
+# ---------------------------------------------------------------------------
+
+#: result digests per world — the cross-world bit-identity pin
+_WORLD_DIGESTS = {}
+
+
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_incremental_refresh_delta_only_bit_identical(world, request,
+                                                      tmp_path):
+    if world > 1:  # materialize the ambient mesh the stream must ignore
+        request.getfixturevalue(f"ctx{world}")
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        b = _batches()
+        s = StreamTable(f"orders-w{world}")
+        s.append(b[0])
+        q = GroupByQuery(s, ["k"], {"v": ["sum", "mean", "count"]})
+        f1, st1 = q.refresh()
+        assert st1["mode"] == "incremental"
+        assert st1["parts_run"] == 1 and st1["partial_rows"] == 16
+        _assert_bit_identical(f1, q.recompute_cold())
+
+        s.append(b[1])
+        f2, st2 = q.refresh()  # compiles the combine kernel (first ever)
+        assert st2["parts_run"] == 1 and st2["partial_rows"] == 16
+
+        # the reused plan: same-shaped delta -> zero compiles, and the
+        # device work is bounded by the batch
+        s.append(b[2])
+        miss0 = obs_metrics.counter_value("plan_cache.miss")
+        delta0 = obs_metrics.counter_value("stream.rows_delta")
+        f3, st3 = q.refresh()
+        assert obs_metrics.counter_value("plan_cache.miss") == miss0
+        assert obs_metrics.counter_value("stream.rows_delta") - delta0 == 16
+        assert st3["parts_run"] == 1 and st3["partial_rows"] == 16
+        assert st3["passes_skipped"] == 2  # batches answered from state
+
+        _assert_bit_identical(f3, q.recompute_cold())
+        _WORLD_DIGESTS.setdefault("groupby", _digest(f3))
+        assert _WORLD_DIGESTS["groupby"] == _digest(f3), \
+            f"stream refresh drifted across worlds at world={world}"
+
+        # unchanged watermark -> pure cache hit, bit-identical
+        f4, st4 = q.refresh()
+        assert st4["parts_run"] == 0 and st4["passes_skipped"] == 1
+        _assert_bit_identical(f4, f3)
+        from cylon_tpu.serve.cache import served_from_journal
+
+        assert served_from_journal(st4) and not served_from_journal(st3)
+
+
+def test_refresh_resumes_from_persisted_state(tmp_path):
+    """A FRESH process (fresh handles here) reloads the spilled partial
+    state and folds only the delta — and the state roundtrip introduces
+    zero drift vs the cold oracle."""
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        b = _batches(seed=23)
+        s = StreamTable("resume")
+        s.append(b[0])
+        s.append(b[1])
+        q = GroupByQuery(s, ["k"], {"v": ["sum", "min", "var"]})
+        q.refresh()
+
+        s2 = StreamTable("resume")
+        assert s2.watermark == 2
+        q2 = GroupByQuery(s2, ["k"], {"v": ["sum", "min", "var"]})
+        s2.append(b[2])
+        f, st = q2.refresh()
+        assert st["parts_run"] == 1 and st["partial_rows"] == 16, st
+        _assert_bit_identical(f, q2.recompute_cold())
+
+
+def test_nunique_refreshes_in_full_mode(tmp_path):
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        s = StreamTable("nu")
+        s.append({"k": np.array([1, 1, 2]), "v": np.array([3, 4, 3])})
+        s.append({"k": np.array([2, 1]), "v": np.array([9, 3])})
+        q = GroupByQuery(s, ["k"], {"v": "nunique"})
+        f, st = q.refresh()
+        assert st["mode"] == "full" and not q.incremental
+        assert f["k"].tolist() == [1, 2]
+        assert f["nunique_v"].tolist() == [2, 2]
+        assert "FULL" in q.explain() and "NUNIQUE" in q.explain()
+
+
+# ---------------------------------------------------------------------------
+# incremental join over a static dim table
+# ---------------------------------------------------------------------------
+
+def test_incremental_join_probes_only_delta(tmp_path):
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        s = StreamTable("fact")
+        s.append({"k": np.array([1, 2, 3]), "x": np.array([10., 20., 30.])})
+        dim = {"k": np.array([1, 2, 5]),
+               "name": np.array(["a", "b", "e"], dtype=object)}
+        j = JoinQuery(s, dim, on="k", how="inner")
+        f1, st1 = j.refresh()
+        assert st1["parts_run"] == 1
+        s.append({"k": np.array([2, 5, 9]), "x": np.array([40., 50., 60.])})
+        f2, st2 = j.refresh()
+        # only the delta batch probed; batch 0's probe replayed from spill
+        assert st2["parts_run"] == 1 and st2["passes_skipped"] == 1
+        assert st2["partial_rows"] == 3
+        _assert_bit_identical(f2, j.recompute_cold())
+        assert f2["name"].tolist() == ["a", "b", "b", "e"]
+        assert "INCREMENTAL" in j.explain()
+        assert "broadcast" in j.explain()
+
+
+# ---------------------------------------------------------------------------
+# kill -9 mid-append, fresh-process resume (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def _worker_env(tmp_path, **knobs):
+    env = dict(os.environ)
+    env.pop("CYLON_TPU_FAULT_PLAN", None)
+    env["CYLON_TPU_DURABLE_DIR"] = str(tmp_path / "journal")
+    env.update({k: v for k, v in knobs.items() if v is not None})
+    return env
+
+
+@pytest.mark.fault
+def test_killhard_mid_append_resume_bit_identical(tmp_path):
+    """kill -9 inside the third append's spill/manifest window, then a
+    FRESH process re-runs the identical driver: committed appends replay
+    as no-ops, the torn batch lands cleanly, and the final refresh is
+    bit-identical to the cold recompute while folding ONLY the delta."""
+    from tests import stream_worker
+
+    # the killed run: appends only, dies mid-append of batch 3
+    killed = subprocess.run(
+        [sys.executable, "-m", "tests.stream_worker",
+         str(tmp_path / "k.npz"), str(tmp_path / "k.json"), "--append-only"],
+        cwd=REPO, env=_worker_env(
+            tmp_path, CYLON_TPU_FAULT_PLAN="journal_commit@3=killhard"),
+        capture_output=True, text=True, timeout=300)
+    assert killed.returncode == 137, (killed.returncode, killed.stderr[-2000:])
+
+    out, stats_path = tmp_path / "r.npz", tmp_path / "r.json"
+    resumed = subprocess.run(
+        [sys.executable, "-m", "tests.stream_worker", str(out),
+         str(stats_path)],
+        cwd=REPO, env=_worker_env(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+
+    stats = json.loads(stats_path.read_text())
+    assert stats["watermark"] == 3
+    assert stats["batches_appended"] == 1  # only the torn batch was new
+    last = stats["refreshes"][-1]
+    # delta-only on the reused plan: rows_delta == batch rows, zero
+    # recompiles, device work bounded by the batch
+    assert last["rows_delta"] == stream_worker.ROWS, last
+    assert last["partial_rows"] == stream_worker.ROWS, last
+    assert last["parts_run"] == 1 and last["plan_cache_miss"] == 0, last
+
+    # the cold golden, journal-free, in THIS process
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=""):
+        s = StreamTable("golden")
+        for b in stream_worker.batches():
+            s.append(b)
+        golden = GroupByQuery(
+            s, ["k"], {"v": ["sum", "mean", "count"]}).recompute_cold()
+    got = dict(np.load(out, allow_pickle=True))
+    _assert_bit_identical(got, golden)
+
+
+# ---------------------------------------------------------------------------
+# GC pinning: live stream state survives the LRU sweep
+# ---------------------------------------------------------------------------
+
+def test_pinned_stream_state_survives_gc(tmp_path):
+    obs_metrics.reset()
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        s = StreamTable("hot-dashboard")
+        s.append({"k": np.arange(64), "v": np.ones(64)})
+        q = GroupByQuery(s, ["k"], {"v": "sum"})
+        q.refresh()
+        # a cold, unpinned victim run
+        j = durable.open_run("f" * 64, "victim")
+        j.record_pass(0, 0, {"x": np.arange(32)}, 32)
+        j.record_done(1, 32)
+        old = os.path.join(str(tmp_path), "f" * 64)
+        os.utime(os.path.join(old, durable.MANIFEST), (1, 1))
+        q.refresh()  # cache hit; moves the live-journal guard off victim
+
+        pinned_dirs = [r["dir"] for r in durable.scan_runs(str(tmp_path))
+                       if r["pinned"]]
+        assert len(pinned_dirs) >= 2  # the batch log + the state run
+
+        evicted, _ = durable.gc_journal(str(tmp_path), cap=1)
+        assert evicted >= 1 and not os.path.exists(old)
+        for d in pinned_dirs:
+            assert os.path.exists(d), f"pinned run {d} was evicted"
+        assert obs_metrics.counter_value("durable.gc_skipped_pinned") >= 2
+
+        # retiring the stream re-admits everything to the LRU sweep
+        s.close(unpin=True)
+        q.close(unpin=True)
+        assert not any(r["pinned"] for r in durable.scan_runs(str(tmp_path)))
+    obs_metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# serve/router integration
+# ---------------------------------------------------------------------------
+
+def test_serve_refresh_op_cache_and_hedge_safety(tmp_path):
+    from cylon_tpu.router.service import HEDGE_SAFE_OPS
+    from cylon_tpu.serve.service import OPS, QueryService
+
+    assert "refresh" in OPS and "refresh" in HEDGE_SAFE_OPS
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        s = StreamTable("served")
+        for b in _batches(seed=31):
+            s.append(b)
+        spec = {"kind": "groupby", "stream": "served", "by": ["k"],
+                "agg": {"v": ["sum", "count"]}}
+        with QueryService() as svc:
+            tk = svc.submit("tenant-a", "refresh", spec)
+            frame, stats = tk.result(timeout=300)
+            assert stats["watermark"] == 3 and stats["parts_run"] >= 1
+            # unchanged watermark -> the hedged/repeated submit is a
+            # pure result-cache hit on any replica sharing the journal
+            tk2 = svc.submit("tenant-a", "refresh", spec)
+            frame2, stats2 = tk2.result(timeout=300)
+            assert tk2.cache_hit, stats2
+            _assert_bit_identical(frame2, frame)
+
+        # the spec round-trip is the router-routability contract: a
+        # fresh "replica" rebuilds the stream from the shared journal
+        frame3, stats3 = run_refresh(spec)
+        assert stats3["parts_run"] == 0 and stats3["passes_skipped"] == 1
+        _assert_bit_identical(frame3, frame)
+
+        # direct query agrees with the serve path bit-for-bit
+        golden = GroupByQuery(StreamTable("served"), ["k"],
+                              {"v": ["sum", "count"]}).recompute_cold()
+        _assert_bit_identical(frame, golden)
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_stream_counters_always_scrape():
+    from cylon_tpu.obs import openmetrics
+
+    text = openmetrics.render({"counters": {}, "gauges": {}})
+    assert "cylon_tpu_stream_batches_appended_total 0" in text
+    assert "cylon_tpu_stream_rows_delta_total 0" in text
+
+
+def test_explain_refresh_renders_decision(tmp_path):
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        s = StreamTable("exp")
+        s.append({"k": np.arange(4), "v": np.ones(4)})
+        q = GroupByQuery(s, ["k"], {"v": ["sum", "mean"]})
+        text = q.explain()
+        assert "INCREMENTAL" in text and "watermark=1" in text
+        assert "finalize" in text and "sum(v)" in text

@@ -1088,6 +1088,62 @@ if [ $rc -ne 0 ]; then
   echo "adaptive planner smoke failed (rc=$rc); fix the cost-based planner before the full tree" >&2
   exit $rc
 fi
+# streaming ingestion smoke (ISSUE-19): three appended micro-batches
+# with a refresh after each, kill -9 (os._exit at the journal-commit
+# fault point) INSIDE the third append, then a fresh process re-runs the
+# identical driver — committed appends replay as idempotent no-ops, the
+# torn batch lands cleanly, and the final refresh must be bit-identical
+# to a journal-free cold recompute while folding ONLY the delta
+# (rows_delta == batch rows, plan_cache.miss == 0 on the reused plan);
+# asserted from the artifact JSON — catches a streaming-state
+# regression in ~30 s, before the full tree runs
+ST=$(mktemp -d /tmp/cylon_stream_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_DURABLE_DIR="$ST/journal" \
+    CYLON_TPU_FAULT_PLAN='journal_commit@3=killhard' \
+    python -m tests.stream_worker "$ST/killed.npz" "$ST/killed.json" \
+    --append-only >/dev/null 2>&1
+krc=$?
+if [ $krc -ne 137 ]; then
+  echo "streaming smoke: killhard append exited $krc (expected 137)" >&2
+  rm -rf "$ST"; exit 1
+fi
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_DURABLE_DIR="$ST/journal" \
+    python -m tests.stream_worker "$ST/resumed.npz" "$ST/resumed.json" \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    CYLON_TPU_DURABLE_DIR= \
+    python -m tests.stream_worker "$ST/base.npz" "$ST/base.json" \
+  && python - "$ST" <<'PYEOF'
+import json, sys
+import numpy as np
+from tests.stream_worker import ROWS
+d = sys.argv[1]
+stats = json.load(open(f"{d}/resumed.json"))
+assert stats["watermark"] == 3 and stats["batch_rows"] == [ROWS] * 3, stats
+assert stats["batches_appended"] == 1, stats  # only the torn batch is new
+last = stats["refreshes"][-1]
+assert last["mode"] == "incremental", last
+assert last["rows_delta"] == ROWS, last      # delta == the one new batch
+assert last["partial_rows"] == ROWS, last    # device work bounded by it
+assert last["parts_run"] == 1, last
+assert last["plan_cache_miss"] == 0, last    # the reused plan recompiles 0
+r = np.load(f"{d}/resumed.npz", allow_pickle=True)
+b = np.load(f"{d}/base.npz", allow_pickle=True)
+assert set(r.files) == set(b.files)
+for f in b.files:
+    assert r[f].dtype == b[f].dtype, f
+    np.testing.assert_array_equal(r[f], b[f], err_msg=f)
+print(f"streaming smoke ok: resumed refresh folded {last['rows_delta']} "
+      f"delta rows (1 of 3 batches, 0 recompiles), bit-identical to the "
+      f"journal-free cold recompute")
+PYEOF
+rc=$?
+rm -rf "$ST"
+if [ $rc -ne 0 ]; then
+  echo "streaming smoke failed (rc=$rc); fix streaming ingestion before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

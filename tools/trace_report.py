@@ -391,6 +391,21 @@ def print_report(trace_path: str, metrics_path: "str | None",
             print(f"  serve cancelled / tenants quarantined "
                   f"{int(c.get('serve.cancelled', 0))}/"
                   f"{int(c.get('serve.tenants_quarantined', 0))}")
+        if any(k.startswith("stream.") for k in c):
+            # streaming-ingest summary (PR 19): appended volume vs what
+            # refreshes actually touched — rows_delta tracking batch
+            # rows IS the incrementality evidence
+            print(f"  stream batches / rows      "
+                  f"{int(c.get('stream.batches_appended', 0))}/"
+                  f"{int(c.get('stream.rows_appended', 0))}")
+            print(f"  refreshes / cached         "
+                  f"{int(c.get('stream.refreshes', 0))}/"
+                  f"{int(c.get('stream.refresh_cached', 0))}")
+            print(f"  delta rows folded          "
+                  f"{int(c.get('stream.rows_delta', 0)):>12}"
+                  + (f"  (state regrown x"
+                     f"{int(c.get('stream.state_regrown', 0))})"
+                     if c.get("stream.state_regrown") else ""))
         g = m.get("gauges", {})
         if "hbm.live_bytes" in g:
             print(f"  hbm watermark bytes        "
