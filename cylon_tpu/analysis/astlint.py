@@ -58,6 +58,8 @@ RULES: Dict[str, str] = {
     "CY116": "stream-package reader decodes a persisted partial-"
              "aggregate spill without validating the state schema "
              "version first",
+    "CY117": "persisted .arrow spill bytes read outside a checksum-"
+             "verifying loader",
     "CY201": "missing collective-budget golden file",
     "CY202": "collective-budget regression against the golden file",
     "CY203": "missing lock-order golden file",
@@ -189,6 +191,23 @@ REALIZED_LAYOUT_PRODUCERS = frozenset({"build_spec", "estimate_spec"})
 STREAM_MODULE_PREFIX = "cylon_tpu.stream"
 STATE_DECODE_NAMES = frozenset({"load_pass", "frame_from_ipc_bytes"})
 STATE_VERSION_GUARD = "require_state_version"
+
+#: CY117 (PR 20): a package function that lexically reads persisted
+#: ``.arrow`` spill bytes — a binary-mode ``open`` call plus an
+#: ``.arrow`` string constant in the same function, or a direct
+#: ``frame_from_ipc_bytes`` decode — must ALSO lexically verify a
+#: checksum.  Bitrot on disk is silent; the journal's discipline is
+#: that every byte served off a spill passed a sha256 first, and like
+#: CY116 the pairing is LEXICAL on purpose: validation at a distance
+#: dies quietly in a refactor.  Verification counts as ``sha256``
+#: itself, the journal's verifying loader (``load_pass``), or the
+#: wire's digest-checked blob decode (``blob_from_b64``).  The IPC
+#: codec module is exempt: it is handed bytes already in memory — the
+#: loader above it owns verification.
+SPILL_DECODE_NAME = "frame_from_ipc_bytes"
+SPILL_SUFFIX = ".arrow"
+SPILL_VERIFY_NAMES = frozenset({"sha256", "load_pass", "blob_from_b64"})
+SPILL_EXEMPT_MODULES = frozenset({"cylon_tpu.io.arrow_io"})
 
 _SUPPRESS_RE = re.compile(
     r"#\s*cylint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--\s*(\S.*))?\s*$")
@@ -1365,6 +1384,72 @@ def _check_state_version(prog: _Program, mod: _Module) -> None:
             f"decode"))
 
 
+def _own_nodes(f: _Func) -> Iterable[ast.AST]:
+    """The nodes lexically belonging to ONE function, skipping nested
+    def/lambda bodies (they carry their own _Func) — the same scoping
+    _FuncScanner applies to ``call_finals``."""
+    stack: List[ast.AST] = [f.node]
+    while stack:
+        n = stack.pop()
+        if n is not f.node and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _reads_spill_bytes(f: _Func) -> bool:
+    """Lexical evidence of a raw spill read: a binary-mode ``open``
+    AND an ``.arrow`` string constant in the same function body."""
+    has_suffix = bin_open = False
+    for n in _own_nodes(f):
+        if (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                and SPILL_SUFFIX in n.value):
+            has_suffix = True
+        elif isinstance(n, ast.Call):
+            if (_dotted(n.func) or "").rsplit(".", 1)[-1] != "open":
+                continue
+            mode = None
+            if len(n.args) >= 2 and isinstance(n.args[1], ast.Constant):
+                mode = n.args[1].value
+            for kw in n.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if (isinstance(mode, str) and "b" in mode
+                    and ("r" in mode or "+" in mode)):
+                bin_open = True
+        if has_suffix and bin_open:
+            return True
+    return False
+
+
+def _check_spill_reads(prog: _Program, mod: _Module) -> None:
+    """CY117: see the SPILL_* constants block — any package function
+    that lexically reads persisted ``.arrow`` spill bytes (raw binary
+    open, or the IPC decode) without lexically verifying a checksum."""
+    if (not mod.name.startswith("cylon_tpu")
+            or mod.name in SPILL_EXEMPT_MODULES):
+        return
+    for f in mod.funcs.values():
+        if f.call_finals & SPILL_VERIFY_NAMES:
+            continue
+        if SPILL_DECODE_NAME in f.call_finals:
+            how = f"decodes spill IPC bytes ({SPILL_DECODE_NAME})"
+        elif _reads_spill_bytes(f):
+            how = "reads .arrow spill bytes with a binary-mode open"
+        else:
+            continue
+        mod.findings.append(Finding(
+            "CY117", mod.path, f.lineno,
+            f"`{f.qual.rsplit('.', 1)[-1]}` {how} without verifying a "
+            f"checksum — silent bitrot in a persisted spill would be "
+            f"served as truth instead of triggering read-repair or "
+            f"quarantine",
+            f"verify hashlib.sha256 against the manifest entry in THIS "
+            f"function, or go through a verifying loader "
+            f"({', '.join(sorted(SPILL_VERIFY_NAMES - {'sha256'}))})"))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -1406,6 +1491,7 @@ def scan_paths(paths: Sequence[str]) -> List[Finding]:
         _check_plan_fingerprint(prog, mod)
         _check_adaptive_fingerprint(prog, mod)
         _check_state_version(prog, mod)
+        _check_spill_reads(prog, mod)
         for f in mod.funcs.values():
             if f.qual in traced:
                 _Taint(f, mod, mod.findings).run()

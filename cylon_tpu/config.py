@@ -332,6 +332,24 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in [
             "serving a torn journal).  Shared by the serving layer's "
             "result cache.  0 (default) = unbounded (pre-PR-7 "
             "behavior)."),
+    _K("CYLON_TPU_DURABLE_RF", "int", 2, RUNTIME,
+       accessors=("cylon_tpu.durable.replication_factor",),
+       help="Target copies of every completed journal run across the "
+            "fleet's DISTINCT journal roots (anti-entropy replication: "
+            "replicas advertise per-run digests on heartbeats, the "
+            "coordinator hints under-replicated runs back, replicas "
+            "pull them spills-first/manifest-last).  gc_journal never "
+            "evicts a run while fewer than this many roots hold it.  "
+            "1 disables replication entirely (PR-19 single-root "
+            "behavior, byte-identical)."),
+    _K("CYLON_TPU_SCRUB_S", "float", 0.0, RUNTIME,
+       accessors=("cylon_tpu.durable.scrub_interval_s",),
+       help="Seconds between background journal-integrity scrub passes "
+            "(re-verify every committed spill's sha256 under the GC "
+            "lease; repair from a peer when one holds a good copy, "
+            "quarantine manifest-LAST otherwise).  0 (default) disables "
+            "the scrubber thread — corruption is then caught lazily at "
+            "load time."),
     _K("CYLON_TPU_SERVE_QUEUE_CAP", "int", 64, RUNTIME,
        accessors=("cylon_tpu.serve.service.queue_cap",),
        help="Bounded admission queue of the multi-tenant query service: "

@@ -62,6 +62,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import config
+from . import durable_lease
 from .obs import metrics as obs_metrics
 from .obs import spans as obs_spans
 from .obs import tracectx
@@ -81,10 +82,12 @@ MANIFEST = "MANIFEST.jsonl"
 #: racing a concurrent replica's sweep still protects the run.
 PINNED = "PINNED"
 
-#: advisory cross-process GC lease file (journal root); a GC holding a
-#: lease younger than the TTL excludes every other replica's GC
-GC_LOCK = "GC_LOCK"
-_GC_LEASE_TTL_S = 30.0
+#: advisory cross-process walker lease (journal root) — the ONE
+#: implementation lives in `durable_lease` (stdlib-only so
+#: tools/journal_fsck.py can load it by file path); re-exported here for
+#: the PR-16 call sites and tests
+GC_LOCK = durable_lease.GC_LOCK
+_GC_LEASE_TTL_S = durable_lease.LEASE_TTL_S
 
 #: minimum seconds between load-time manifest-mtime freshens (the LRU
 #: clock a long replay must keep advancing under the shared journal)
@@ -129,6 +132,24 @@ def quota_bytes() -> int:
     the quota refuses the write up front — the run degrades to
     journal-off execution instead of filling a shared disk."""
     return max(0, int(config.knob("CYLON_TPU_DURABLE_QUOTA_BYTES")))
+
+
+def replication_factor() -> int:
+    """Target copies of every completed run across the fleet's journal
+    roots (``CYLON_TPU_DURABLE_RF``, default 2).  1 disables anti-entropy
+    replication entirely — byte-identical to the PR-19 single-root
+    behavior (pinned by tests).  Only meaningful when replicas journal to
+    DISTINCT roots; replicas sharing one filesystem root are one copy."""
+    return max(1, int(config.knob("CYLON_TPU_DURABLE_RF")))
+
+
+def scrub_interval_s() -> float:
+    """Seconds between background integrity-scrub passes
+    (``CYLON_TPU_SCRUB_S``); 0 (default) disables the scrubber thread —
+    corruption is then detected lazily at load time, the pre-PR-20
+    behavior.  ``durable_sync.scrub_once`` can always be called
+    directly (tools/journal_fsck.py is the offline twin)."""
+    return max(0.0, float(config.knob("CYLON_TPU_SCRUB_S")))
 
 
 # ---------------------------------------------------------------------------
@@ -515,8 +536,16 @@ class RunJournal:
     def load_pass(self, level: int, part: int):
         """(frame, rows) for a journaled pass, or None when the pass is
         not recorded — or its spill is missing/truncated/corrupt (checksum
-        mismatch), in which case the record is dropped so the pass simply
-        re-executes."""
+        mismatch) AND no peer holds a good copy, in which case the record
+        is dropped so the pass simply re-executes.
+
+        Read-repair (PR 20): a local checksum failure first degrades to
+        fetching the spill from a peer replica's journal
+        (`durable_sync.attempt_read_repair`) — the fetched bytes must
+        match the SAME manifest sha256, are rewritten locally tmp+fsync+
+        rename, and are served bit-identically.  A request never fails
+        over corruption any replica can still repair; only when no peer
+        holds a good copy does the pass fall back to re-execution."""
         entry = self._passes.get((int(level), int(part)))
         if entry is None:
             return None
@@ -531,21 +560,44 @@ class RunJournal:
         self._freshen()
         path = os.path.join(self.dir, entry["file"])
         with obs_spans.span("durable.load", level=level, part=part):
+            why = None
             try:
                 with open(path, "rb") as fh:
                     payload = fh.read()
             except OSError as e:
-                return self._reject(level, part, f"unreadable spill: {e}")
-            if hashlib.sha256(payload).hexdigest() != entry["sha256"]:
-                return self._reject(level, part,
-                                    "checksum mismatch (truncated/corrupt)")
+                payload, why = None, f"unreadable spill: {e}"
+            if (payload is not None
+                    and hashlib.sha256(payload).hexdigest()
+                    != entry["sha256"]):
+                payload, why = None, "checksum mismatch (truncated/corrupt)"
+            if payload is None:
+                payload = self._read_repair(entry, why)
+                if payload is None:
+                    return self._reject(level, part, why)
             try:
                 frame = arrow_io.frame_from_ipc_bytes(payload)
             except Exception as e:
+                # a decode failure UNDER a passing checksum is a recorded
+                # bad payload — a peer's copy would be the same bytes, so
+                # repair cannot help; re-execute
                 return self._reject(level, part,
                                     f"undecodable spill: "
                                     f"{type(e).__name__}: {e}")
         return frame, int(entry["rows"])
+
+    def _read_repair(self, entry: dict, why: str) -> Optional[bytes]:
+        """Fetch one bad spill's bytes from a peer journal (verified
+        against OUR manifest sha256, rewritten locally) — None when no
+        peer is registered or none holds a good copy.  Guarded: repair
+        is an optimization over re-execution and must never raise."""
+        try:
+            from . import durable_sync
+            return durable_sync.attempt_read_repair(
+                self.dir, self.fingerprint, entry, why)
+        except Exception as e:  # pragma: no cover - defensive
+            log.warning("durable: read-repair attempt failed (%s: %s)",
+                        type(e).__name__, e)
+            return None
 
     def _freshen(self) -> None:
         now = time.monotonic()
@@ -690,6 +742,126 @@ def scan_runs(root: Optional[str] = None) -> List[dict]:
     return out
 
 
+def read_manifest(d: str) -> Optional[dict]:
+    """Structured, integrity-aware parse of one run dir's manifest (the
+    scrubber's view — `RunJournal._open` keeps its own minimal replay):
+    ``header`` / ``passes`` ({(level, part): entry}) / ``done`` /
+    ``quarantined``, plus two corruption classifications the replay
+    deliberately conflates:
+
+    - ``torn_tail`` — the LAST line(s) fail to parse with nothing
+      parseable after them: the expected shape of a crash mid-append,
+      clean by contract (everything before the tear stands).
+    - ``midline_corrupt`` — an unparseable line FOLLOWED by parseable
+      lines: impossible under the fsync'd append-only discipline, so it
+      is bitrot inside committed history; entries after the bad line
+      cannot be trusted to be complete and the run must quarantine.
+
+    None when the dir has no readable manifest at all."""
+    path = os.path.join(d, MANIFEST)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            raw_lines = fh.read().splitlines()
+    except OSError:
+        return None
+    out = {"header": None, "passes": {}, "done": None, "quarantined": [],
+           "torn_tail": False, "midline_corrupt": False,
+           "lines": len(raw_lines)}
+    bad_seen = False
+    for raw in raw_lines:
+        try:
+            entry = json.loads(raw)
+            if not isinstance(entry, dict):
+                raise ValueError("manifest line is not an object")
+        except ValueError:
+            bad_seen = True
+            out["torn_tail"] = True
+            continue
+        if bad_seen:
+            # a good line after a bad one: committed history was torn
+            out["midline_corrupt"] = True
+            out["torn_tail"] = False
+            break
+        kind = entry.get("kind")
+        if kind == "run":
+            out["header"] = entry
+        elif kind == "pass":
+            try:
+                out["passes"][(int(entry["level"]),
+                               int(entry["part"]))] = entry
+            except (KeyError, TypeError, ValueError):
+                out["midline_corrupt"] = True
+                break
+        elif kind == "quarantine":
+            out["quarantined"].append(entry)
+        elif kind == "done":
+            out["done"] = entry
+    return out
+
+
+# run-digest cache: dir -> ((manifest mtime_ns, size), digest record).
+# The digest is pure manifest content, so the (mtime, size) pair is a
+# sound invalidation key under the fsync'd append-only discipline.
+_DIGEST_CACHE: Dict[str, Tuple[Tuple[int, int], dict]] = {}
+_DIGEST_CACHE_MAX = 4096
+
+
+def run_digest(d: str) -> Optional[dict]:
+    """Replication identity of one run dir, from the manifest ALONE (no
+    spill reads — this runs on every heartbeat): ``digest`` folds the
+    sorted (file, sha256) pass pairs plus the done flag, so two roots
+    agree on a digest exactly when they hold the same committed content.
+    Also carries ``complete`` / ``pinned`` / ``passes`` for the
+    coordinator's placement math.  None for unreadable or header-less
+    dirs (a mid-sync run not yet visible — by design)."""
+    path = os.path.join(d, MANIFEST)
+    try:
+        st = os.stat(path)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+    cached = _DIGEST_CACHE.get(d)
+    if cached is not None and cached[0] == key:
+        rec = dict(cached[1])
+        rec["pinned"] = os.path.exists(os.path.join(d, PINNED))
+        return rec
+    m = read_manifest(d)
+    if m is None or m["header"] is None:
+        return None
+    h = hashlib.sha256()
+    for (level, part), entry in sorted(m["passes"].items()):
+        h.update(f"{level}:{part}:{entry.get('file')}:"
+                 f"{entry.get('sha256')}\n".encode())
+    h.update(b"done" if m["done"] is not None else b"open")
+    rec = {"digest": h.hexdigest(),
+           "complete": m["done"] is not None,
+           "passes": len(m["passes"]),
+           "bytes": sum(int(e.get("bytes", 0))
+                        for e in m["passes"].values())}
+    if len(_DIGEST_CACHE) >= _DIGEST_CACHE_MAX:
+        _DIGEST_CACHE.clear()
+    _DIGEST_CACHE[d] = (key, dict(rec))
+    rec["pinned"] = os.path.exists(os.path.join(d, PINNED))
+    return rec
+
+
+def journal_digests(root: Optional[str] = None, cap: int = 512) -> Dict[str, dict]:
+    """Per-run digests for heartbeat advertisement: fingerprint ->
+    :func:`run_digest` record, most-recently-used runs first when the
+    root holds more than ``cap`` (the hot runs are the ones worth
+    replicating first; the rest ride later beats as the set churns)."""
+    root = durable_dir() if root is None else root
+    runs = scan_runs(root)
+    out: Dict[str, dict] = {}
+    for r in reversed(runs):  # scan_runs sorts LRU-first; advertise MRU
+        if len(out) >= max(1, int(cap)):
+            break
+        rec = run_digest(r["dir"])
+        if rec is not None:
+            out[r["fingerprint"]] = rec
+    return out
+
+
 def _evict_run_dir(d: str) -> None:
     """Remove one run dir MANIFEST-LAST: spills go first, the manifest
     after them, the dir itself at the end.  A crash (or a concurrent
@@ -710,50 +882,37 @@ def _evict_run_dir(d: str) -> None:
 
 
 def _acquire_gc_lease(root: str) -> Optional[str]:
-    """Advisory cross-process GC lease: O_CREAT|O_EXCL on
-    ``<root>/GC_LOCK`` (pid + wall-clock inside, for operators).  Returns
-    the lease path, or None when another replica's GC holds a lease
-    younger than the TTL.  A stale lease (crashed holder) is broken by an
-    atomic rewrite — two breakers racing the rewrite is acceptable for an
-    ADVISORY lease: the per-victim manifest re-read under the lease is
-    what protects correctness, the lease only serializes the common
-    case."""
-    path = os.path.join(root, GC_LOCK)
-    payload = json.dumps({"pid": os.getpid(), "ts": time.time()}) + "\n"
-    try:
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-    except FileExistsError:
-        try:
-            age = time.time() - os.path.getmtime(path)
-        except OSError:
-            return None  # holder released between exists and stat
-        if age < _GC_LEASE_TTL_S:
-            obs_metrics.counter_add("durable.gc_lease_busy")
-            return None
-        tmp = path + f".tmp.{os.getpid()}"
-        try:
-            with open(tmp, "w", encoding="utf-8") as fh:
-                fh.write(payload)
-            os.replace(tmp, path)
-        except OSError:
-            with contextlib.suppress(OSError):
-                os.remove(tmp)
-            return None
-        log.warning("durable: broke stale GC lease at %s (age %.1fs)",
-                    path, age)
-        return path
-    except OSError:
-        return None
-    try:
-        os.write(fd, payload.encode())
-    finally:
-        os.close(fd)
-    return path
+    """Advisory cross-process walker lease over ``root`` — delegated to
+    the shared stdlib-only implementation in :mod:`durable_lease` (PR 20:
+    GC, scrubber and fsck must exclude each other through ONE lease, not
+    three drifting copies).  Returns the lease path, or None when another
+    walker holds a lease younger than the TTL (counted
+    ``durable.gc_lease_busy``)."""
+    return durable_lease.acquire_lease(
+        root, ttl_s=_GC_LEASE_TTL_S,
+        on_busy=lambda: obs_metrics.counter_add("durable.gc_lease_busy"))
 
 
 def _release_gc_lease(path: str) -> None:
-    with contextlib.suppress(OSError):
-        os.remove(path)
+    durable_lease.release_lease(path)
+
+
+# fingerprint -> bool guard installed by the replication syncer (PR 20):
+# True means the coordinator still counts OUR copy of this run toward
+# CYLON_TPU_DURABLE_RF (holders <= RF), so LRU-evicting it here would
+# silently drop the fleet below its replication target on a peer-less
+# (or not-yet-caught-up) fleet.  None (default, and whenever no fleet
+# syncer is attached) preserves the PR-16 behavior exactly.
+_REPLICATION_GUARD = None
+
+
+def set_gc_replication_guard(fn) -> None:
+    """Install (or clear, with None) the fingerprint->bool guard
+    ``gc_journal`` consults before evicting a run (see
+    ``_REPLICATION_GUARD``).  Called by `durable_sync.JournalSyncer` from
+    heartbeat replies; the guard must be cheap and non-raising."""
+    global _REPLICATION_GUARD
+    _REPLICATION_GUARD = fn
 
 
 def gc_journal(root: Optional[str] = None,
@@ -800,6 +959,13 @@ def gc_journal(root: Optional[str] = None,
                 # a stream that pinned its state after our inventory
                 # must still survive this sweep
                 obs_metrics.counter_add("durable.gc_skipped_pinned")
+                continue
+            guard = _REPLICATION_GUARD
+            if guard is not None and guard(r["fingerprint"]):
+                # the coordinator still counts our copy toward
+                # CYLON_TPU_DURABLE_RF: evicting it would silently drop
+                # the fleet below its replication target (PR 20)
+                obs_metrics.counter_add("durable.gc_skipped_replication")
                 continue
             manifest = os.path.join(r["dir"], MANIFEST)
             try:
@@ -858,6 +1024,38 @@ def _corrupt_last_spill() -> None:
         fh.truncate(size // 2)
     log.warning("durable: injected corruption truncated %s to %d bytes",
                 path, size // 2)
+
+
+def _bitrot_last_run(hit: int = 0) -> None:  # cylint: disable=CY117 -- deliberate fault injector: flips a spill byte to MANUFACTURE the bitrot CY117 guards against; verifying a checksum here would defeat the test hook
+    """Test hook behind the ``bitrot`` fault kind (PR 20): XOR-flip ONE
+    mid-file byte of a committed spill in the most recently opened run —
+    the silent-decay failure the scrubber and read-repair exist to catch
+    (vs ``journal_corrupt``'s blunt truncation).  The victim spill is
+    chosen deterministically from the fault hit counter so subprocess
+    chaos tests replay identically."""
+    j = _LAST_JOURNAL
+    if j is None or not os.path.isdir(j.dir):
+        return
+    spills = sorted(fn for fn in os.listdir(j.dir) if fn.endswith(".arrow"))
+    if not spills:
+        return
+    victim = os.path.join(
+        j.dir, spills[(int(hit) * 2654435761) % len(spills)])
+    try:
+        size = os.path.getsize(victim)
+        if size == 0:
+            return
+        with open(victim, "r+b") as fh:
+            fh.seek(size // 2)
+            b = fh.read(1)
+            fh.seek(size // 2)
+            fh.write(bytes([b[0] ^ 0xFF]))
+            fh.flush()
+            os.fsync(fh.fileno())
+    except OSError:
+        return
+    log.warning("durable: injected bitrot flipped byte %d of %s",
+                size // 2, victim)
 
 
 # ---------------------------------------------------------------------------

@@ -748,6 +748,93 @@ class Coordinator:
                         dst[key] = int(dst.get(key, 0)) + int(row[key])
         return agg
 
+    #: caps on the journal-replication reply fields (PR 20): hints are a
+    #: per-beat pull burst bound (the next beat carries more — anti-
+    #: entropy converges incrementally), the guard cap only bounds a
+    #: pathological advertisement (the digest cap upstream is smaller)
+    JOURNAL_SYNC_HINTS_MAX = 8
+    JOURNAL_GUARD_MAX = 4096
+
+    def _journal_reply_locked(self, rank: int) -> Dict:
+        """Journal anti-entropy placement for one heartbeat reply (PR 20).
+
+        Replicas advertise ``{"journal": {"addr", "root", "digests"}}``
+        in their heartbeat telemetry; this diffs those advertisements
+        against ``CYLON_TPU_DURABLE_RF`` and answers THIS rank with:
+
+        - ``journal_peers`` — live peers' journal data-plane addresses
+          (the read-repair fetch targets);
+        - ``journal_sync``  — pull hints for under-replicated runs this
+          rank should replicate (pinned stream-state first; deterministic
+          assignment: the first ``RF - holders`` non-holder ranks in
+          rank order pull, so two beats never double-assign);
+        - ``journal_guard`` — fingerprints whose LOCAL copy is load-
+          bearing (holders < RF: the fleet is ALREADY at or below its
+          replication target without losing ours), which this rank's
+          ``gc_journal`` must not evict: on a peer-less fleet every run
+          is guarded, because this root holds the only copy the
+          coordinator knows about.  At RF=1 nothing is ever guarded —
+          the PR-16 GC behavior, exactly.
+
+        Holder counting is by DISTINCT root (realpath): replicas sharing
+        one filesystem journal are one copy, not two.  Empty when no
+        replica advertises a journal — the whole feature costs nothing
+        on fleets that never turned it on."""
+        recs: Dict[int, Dict] = {}
+        for r, tel in self._telemetry.items():
+            if r in self._dead or r not in self._last_hb:
+                continue
+            j = tel.get("journal") if isinstance(tel, dict) else None
+            if isinstance(j, dict) and j.get("addr") and j.get("root"):
+                recs[r] = j
+        me = recs.get(rank)
+        if me is None:
+            return {}
+        from . import durable
+
+        rf = durable.replication_factor()
+        out: Dict = {"journal_peers": {
+            str(r): list(j["addr"]) for r, j in sorted(recs.items())
+            if r != rank}}
+        my_root = me.get("root")
+        # fingerprint -> {root -> (rank, addr)} over complete/pinned runs
+        holders: Dict[str, Dict] = {}
+        for r, j in sorted(recs.items()):
+            digests = j.get("digests")
+            if not isinstance(digests, dict):
+                continue
+            for fp, rec in digests.items():
+                if not isinstance(rec, dict) \
+                        or not (rec.get("complete") or rec.get("pinned")):
+                    continue
+                h = holders.setdefault(str(fp), {"roots": {},
+                                                 "pinned": False})
+                h["roots"].setdefault(j["root"], (r, j["addr"]))
+                h["pinned"] = h["pinned"] or bool(rec.get("pinned"))
+        guard: List[str] = []
+        hints: List[Dict] = []
+        for fp, h in sorted(holders.items()):
+            roots = h["roots"]
+            if my_root in roots:
+                if len(roots) < rf and len(guard) < self.JOURNAL_GUARD_MAX:
+                    guard.append(fp)
+                continue
+            missing = rf - len(roots)
+            if missing <= 0:
+                continue
+            pullers = [r for r in sorted(recs)
+                       if recs[r].get("root") not in roots][:missing]
+            if rank in pullers:
+                src_rank, src_addr = sorted(roots.values())[0]
+                hints.append({"fingerprint": fp, "from": list(src_addr),
+                              "pinned": h["pinned"]})
+        if guard:
+            out["journal_guard"] = guard
+        if hints:
+            hints.sort(key=lambda x: (not x["pinned"], x["fingerprint"]))
+            out["journal_sync"] = hints[:self.JOURNAL_SYNC_HINTS_MAX]
+        return out
+
     def view(self) -> MemberView:
         with self._lock:
             v = self._view_locked()
@@ -1047,7 +1134,13 @@ class Coordinator:
                 m = req.get("metrics")
                 if isinstance(m, dict):
                     self._metrics[rank] = m
-                return {"ok": True, **self._view_locked()}
+                try:
+                    extra = self._journal_reply_locked(rank)
+                except Exception as e:  # never fail a beat over placement
+                    log.debug("elastic: journal reply computation failed "
+                              "(%s: %s)", type(e).__name__, e)
+                    extra = {}
+                return {"ok": True, **extra, **self._view_locked()}
             if cmd == "barrier":
                 name, epoch = str(req.get("name")), req.get("epoch")
                 if (name, epoch) in self._completed_barriers:
@@ -1199,6 +1292,9 @@ class Agent:
         self._thread: Optional[threading.Thread] = None
         self.clock: Optional[obs_fleet.ClockInfo] = None
         self._telemetry_fn: Optional[Callable[[], Dict]] = None
+        # journal-replication reply consumer (PR 20): receives the
+        # coordinator's journal_peers/journal_sync/journal_guard fields
+        self._journal_fn: Optional[Callable[[Dict], None]] = None
         self._beat_n = 0  # metrics ship every METRICS_EVERY_BEATS
         self._barrier_trace: Optional[tracectx.TraceContext] = None
 
@@ -1367,6 +1463,16 @@ class Agent:
         with self._lock:
             self._telemetry_fn = fn
 
+    def attach_journal_sync(self, fn: Optional[Callable[[Dict], None]]) -> None:
+        """Install the consumer for the coordinator's journal-replication
+        reply fields (PR 20: ``journal_peers`` / ``journal_sync`` /
+        ``journal_guard``) — `durable_sync.JournalSyncer.on_heartbeat`.
+        The callback runs on the beat thread and must be CHEAP (enqueue
+        only); exceptions are swallowed — replication must never cost
+        the liveness signal it rides on."""
+        with self._lock:
+            self._journal_fn = fn
+
     def _heartbeat_payload(self) -> Dict:
         obj: Dict = {"cmd": "heartbeat", "rank": self.rank}
         with self._lock:
@@ -1443,6 +1549,20 @@ class Agent:
             obs_metrics.gauge_set("elastic.incarnation", advanced[1])
             log.warning("elastic: rank %d observed coordinator restart "
                         "(incarnation %d -> %d)", self.rank, *advanced)
+        # journal-replication fields (PR 20) ride heartbeat replies;
+        # hand them to the syncer OUTSIDE the lock (the callback only
+        # enqueues, but a slow consumer must not hold membership state)
+        fn = self._journal_fn
+        if fn is not None:
+            doc = {k: resp[k] for k in ("journal_peers", "journal_sync",
+                                        "journal_guard") if k in resp}
+            if doc:
+                try:
+                    fn(doc)
+                except Exception as e:  # never cost the beat
+                    log.debug("elastic: rank %d journal-sync consumer "
+                              "failed: %s: %s", self.rank,
+                              type(e).__name__, e)
 
     def _beat(self) -> None:
         fails = 0

@@ -257,6 +257,16 @@ _KIND_MESSAGES = {
     "disk_full": ("RESOURCE_EXHAUSTED: injected disk full at {site} "
                   "(hit {hit}): no space left on device"),
     "replica_sick": "injected sick replica at {site} (hit {hit})",
+    # journal-integrity kinds (PR 20): `bitrot` XOR-flips one mid-file
+    # byte of a committed spill in the most recently opened run and
+    # continues — silent storage decay (vs `journal_corrupt`'s blunt
+    # truncation), the corruption the scrubber must find and read-repair
+    # must heal; `sync_partial` is killhard under a replication name
+    # (os._exit(137) at the per-file sync probe `journal_sync_file`) —
+    # a replica dying mid-pull, which the spills-first/manifest-LAST
+    # copy order must make invisible
+    "bitrot": "injected spill bitrot at {site} (hit {hit})",
+    "sync_partial": "injected partial journal sync at {site} (hit {hit})",
 }
 
 FAULT_KINDS = tuple(_KIND_MESSAGES)
@@ -423,16 +433,23 @@ def fault_point(site: str) -> None:
         obs_spans.instant("fault.injected", site=site, kind=kind,
                           hit=plan.hits[site])
         obs_metrics.counter_add("fault.injected")
-        if kind in ("killhard", "rank_kill"):
+        if kind in ("killhard", "rank_kill", "sync_partial"):
             # simulate kill -9 / preemption: no cleanup, no atexit, no
             # flushed buffers — exactly what the journal must survive
             # (rank_kill is the elastic-membership spelling: survivors
-            # must detect the silence, shrink, and resume)
+            # must detect the silence, shrink, and resume; sync_partial
+            # is the same death at the replication copy probe — the
+            # manifest-LAST pull order must leave no visible run)
             os._exit(137)
         if kind == "journal_corrupt":
             from . import durable
 
             durable._corrupt_last_spill()
+            return
+        if kind == "bitrot":
+            from . import durable
+
+            durable._bitrot_last_run(plan.hits[site])
             return
         if kind == "cache_evict_race":
             from . import durable

@@ -93,7 +93,7 @@ def encode_value(v):
         f"replica's QueryService directly)")
 
 
-def _ipc_from_b64(data) -> Dict:
+def _ipc_from_b64(data) -> Dict:  # cylint: disable=CY117 -- decodes live wire frames (request/result tables in flight), not persisted .arrow spills; TCP delivers the sender's bytes, there is no at-rest decay for a digest to catch here
     """base64 Arrow IPC -> frame, with decode-side refusals (corrupt
     base64, malformed IPC, a non-string where the marker promised one)
     re-raised CLASSIFIED — the decode side honours the same
@@ -193,6 +193,50 @@ def jsonable(obj, *, _depth: int = 0):
     if isinstance(obj, (set, frozenset)):
         return sorted(str(v) for v in obj)
     return str(obj)
+
+
+# ---------------------------------------------------------------------------
+# checksum-verified blobs (PR 20: journal replication data plane)
+# ---------------------------------------------------------------------------
+
+def blob_b64(data: bytes) -> Dict:
+    """Raw journal bytes (a spill file, a manifest) -> wire dict with an
+    in-band sha256.  Unlike the frame markers above this does NOT decode
+    the payload — replication ships spills byte-verbatim so the copy is
+    bit-identical by construction; the digest rides along so the far
+    side can refuse a damaged transfer without interpreting it."""
+    if not isinstance(data, (bytes, bytearray)):
+        raise CylonError(Code.SerializationError,
+                         f"blob_b64 wants bytes, got {type(data).__name__}")
+    data = bytes(data)
+    return {"blob": _b64(data), "sha256": hashlib.sha256(data).hexdigest(),
+            "bytes": len(data)}
+
+
+def blob_from_b64(d: Dict, expect_sha: Optional[str] = None) -> bytes:
+    """Inverse of :func:`blob_b64`, verifying the in-band digest AND (when
+    given) the caller's independent expectation — read-repair passes the
+    LOCAL manifest's sha256 here, so a peer serving consistent-but-
+    different bytes (a diverged journal) is refused as loudly as a torn
+    transfer.  Mismatches classify `Code.IOError`."""
+    try:
+        data = base64.b64decode(d["blob"])
+    except Exception as e:
+        raise CylonError(Code.SerializationError,
+                         f"cannot decode journal blob from the wire: "
+                         f"{type(e).__name__}: {e}") from e
+    digest = hashlib.sha256(data).hexdigest()
+    if digest != d.get("sha256"):
+        raise CylonError(Code.IOError,
+                         f"journal blob damaged in transfer: sha256 "
+                         f"{digest[:12]} != advertised "
+                         f"{str(d.get('sha256'))[:12]}")
+    if expect_sha is not None and digest != expect_sha:
+        raise CylonError(Code.IOError,
+                         f"peer journal blob diverges from the local "
+                         f"manifest: sha256 {digest[:12]} != expected "
+                         f"{expect_sha[:12]}")
+    return data
 
 
 # ---------------------------------------------------------------------------

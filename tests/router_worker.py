@@ -40,15 +40,19 @@ from cylon_tpu.serve import QueryService  # noqa: E402
 
 
 def _export_snapshot(rank: int) -> None:
-    """Atomic incremental trace export: a rank_kill mid-write must
-    never leave a torn file for trace_merge to choke on."""
-    final = export._artifact_path(None, "trace", rank)
-    tmp = final + f".tmp.{os.getpid()}"
-    try:
-        export.export_trace(path=tmp, rank=rank)
-        os.replace(tmp, final)
-    except OSError:
-        pass  # exports are best-effort; the next tick retries
+    """Atomic incremental trace + metrics export: a rank_kill mid-write
+    must never leave a torn file for trace_merge to choke on, and the
+    self-healing journal smoke asserts this replica's durable.* counters
+    from the metrics artifact after the fleet stands down."""
+    for prefix, exporter in (("trace", export.export_trace),
+                             ("metrics", export.export_metrics)):
+        final = export._artifact_path(None, prefix, rank)
+        tmp = final + f".tmp.{os.getpid()}"
+        try:
+            exporter(path=tmp, rank=rank)
+            os.replace(tmp, final)
+        except OSError:
+            pass  # exports are best-effort; the next tick retries
 
 
 def main() -> int:

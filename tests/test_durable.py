@@ -18,7 +18,7 @@ import time
 import numpy as np
 import pytest
 
-from cylon_tpu import config, durable, resilience
+from cylon_tpu import config, durable, durable_sync, resilience
 from cylon_tpu.exec import (chunked_groupby, chunked_join_groupby_tables,
                             chunked_sort)
 from cylon_tpu.io import arrow_io
@@ -812,3 +812,584 @@ def test_replaying_process_freshens_gc_lru_clock(tmp_path, rng, monkeypatch):
     assert evicted == 1
     survivors = {r["fingerprint"] for r in durable.scan_runs(str(tmp_path))}
     assert hot in survivors and cold not in survivors
+
+
+# ---------------------------------------------------------------------------
+# self-healing journal (PR 20): scrubbing, read-repair, anti-entropy,
+# disaster recovery
+# ---------------------------------------------------------------------------
+
+def _mk_run(root, fp="f" * 64, passes=2, n=24, pin=False):
+    """One completed journaled run under ``root``; returns the frame."""
+    frame = {"k": np.arange(n, dtype=np.int64),
+             "v": np.linspace(0, 1, n).astype(np.float32)}
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(root)):
+        j = durable.open_run(fp, "test")
+        for p in range(passes):
+            j.record_pass(0, p, frame, n)
+        j.record_done(passes, passes * n)
+        if pin:
+            assert j.pin()
+    return frame
+
+
+def _flip_byte(path, offset=None):
+    data = bytearray(open(path, "rb").read())
+    i = len(data) // 2 if offset is None else offset
+    data[i] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+
+@pytest.fixture
+def no_live_journal(monkeypatch):
+    """The scrubber skips the process's own live run dir; these tests
+    scrub roots built through the normal API, so detach the global."""
+    monkeypatch.setattr(durable, "_LAST_JOURNAL", None)
+
+
+@pytest.fixture
+def peerless():
+    durable_sync.set_peers(())
+    yield
+    durable_sync.set_peers(())
+
+
+def test_corruption_matrix_classification(tmp_path, no_live_journal,
+                                          peerless):
+    """The full damage taxonomy, peer-less (so nothing is repairable):
+    spill body/header bitrot quarantine, manifest mid-line corruption
+    quarantines, a torn manifest TAIL is clean by contract, and a
+    damaged PINNED run is never evicted (its bad pass re-executes)."""
+    cases = {"body": "a" * 64, "header": "b" * 64, "midline": "c" * 64,
+             "tail": "d" * 64, "pinned": "e" * 64}
+    for name, fp in cases.items():
+        _mk_run(tmp_path, fp=fp, pin=(name == "pinned"))
+    # spill body + header flips
+    _flip_byte(tmp_path / cases["body"] / "pass_L0_P0.arrow")
+    _flip_byte(tmp_path / cases["header"] / "pass_L0_P1.arrow", offset=4)
+    _flip_byte(tmp_path / cases["pinned"] / "pass_L0_P0.arrow")
+    # manifest mid-line: damage the middle line, keep later lines valid
+    mani = tmp_path / cases["midline"] / durable.MANIFEST
+    lines = mani.read_text().splitlines()
+    lines[1] = lines[1][: len(lines[1]) // 2] + "}garbage{"
+    mani.write_text("\n".join(lines) + "\n")
+    # manifest torn tail: a half-written trailing record
+    mani = tmp_path / cases["tail"] / durable.MANIFEST
+    mani.write_text(mani.read_text() + '{"kind": "pa')
+
+    durable._LAST_JOURNAL = None  # _mk_run left the pinned run live
+    obs_metrics.reset()
+    stats = durable_sync.scrub_once(str(tmp_path))
+    assert stats["runs"] == 5
+    assert stats["quarantined"] == 3       # body, header, midline
+    assert stats["torn"] == 1              # tail stands
+    assert stats["repaired"] == 0
+    assert obs_metrics.counter_value("durable.scrub_corrupt") == 4
+    assert obs_metrics.counter_value("durable.scrub_quarantined") == 3
+    survivors = {r["fingerprint"] for r in durable.scan_runs(str(tmp_path))}
+    assert survivors == {cases["tail"], cases["pinned"]}
+    # the damaged PINNED run stands; its bad pass re-executes at load,
+    # the intact pass still serves
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        j = durable.open_run(cases["pinned"], "test")
+        assert j.load_pass(0, 0) is None
+        assert j.load_pass(0, 1) is not None
+    # the torn-tail run replays everything before the tear
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        j = durable.open_run(cases["tail"], "test")
+        assert j.load_pass(0, 0) is not None
+    obs_metrics.reset()
+
+
+def test_scrub_repairs_from_peer_bit_identical(tmp_path, no_live_journal):
+    """A bitrotted spill heals from a peer holding a good copy: the run
+    survives the scrub and the healed bytes are IDENTICAL to the
+    original spill (not merely decodable)."""
+    rootA, rootB = tmp_path / "a", tmp_path / "b"
+    _mk_run(rootA)
+    _mk_run(rootB)
+    spill = rootA / ("f" * 64) / "pass_L0_P0.arrow"
+    good = spill.read_bytes()
+    _flip_byte(spill)
+    srv = durable_sync.JournalPeerServer(str(rootB))
+    durable_sync.set_peers([srv.address])
+    obs_metrics.reset()
+    try:
+        stats = durable_sync.scrub_once(str(rootA))
+    finally:
+        durable_sync.set_peers(())
+        srv.close()
+    assert stats["corrupt"] == 1 and stats["repaired"] == 1, stats
+    assert stats["quarantined"] == 0
+    assert spill.read_bytes() == good
+    assert obs_metrics.counter_value("durable.scrub_repaired") == 1
+    obs_metrics.reset()
+
+
+def test_scrub_skips_live_run_and_busy_lease(tmp_path, peerless):
+    """The scrubber never walks the process's own OPEN journal, and
+    backs off cleanly when another walker holds the root lease."""
+    _mk_run(tmp_path)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        j = durable.open_run("f" * 64, "test")
+    durable._LAST_JOURNAL = j
+    try:
+        stats = durable_sync.scrub_once(str(tmp_path))
+        assert stats["skipped_live"] == 1 and stats["checked"] == 0
+    finally:
+        durable._LAST_JOURNAL = None
+    lease = durable._acquire_gc_lease(str(tmp_path))
+    assert lease is not None
+    obs_metrics.reset()
+    try:
+        stats = durable_sync.scrub_once(str(tmp_path))
+    finally:
+        durable._release_gc_lease(lease)
+    assert stats["skipped_busy"] == 1 and stats["runs"] == 0
+    assert obs_metrics.counter_value("durable.scrub_lease_busy") == 1
+    obs_metrics.reset()
+
+
+def test_read_repair_serves_bit_identical_and_heals_disk(tmp_path,
+                                                         no_live_journal):
+    """load_pass on a bitrotted spill degrades to a peer fetch: the
+    caller gets the pass (bit-identical), the local spill is rewritten,
+    and a SECOND load serves clean from local disk."""
+    rootA, rootB = tmp_path / "a", tmp_path / "b"
+    frame = _mk_run(rootA)
+    _mk_run(rootB)
+    spill = rootA / ("f" * 64) / "pass_L0_P0.arrow"
+    good = spill.read_bytes()
+    _flip_byte(spill)
+    srv = durable_sync.JournalPeerServer(str(rootB))
+    durable_sync.set_peers([srv.address])
+    obs_metrics.reset()
+    try:
+        with config.knob_env(CYLON_TPU_DURABLE_DIR=str(rootA)):
+            j = durable.open_run("f" * 64, "test")
+            loaded = j.load_pass(0, 0)
+    finally:
+        durable_sync.set_peers(())
+        srv.close()
+    assert loaded is not None, "read-repair should have healed the load"
+    healed, rows = loaded
+    _assert_bit_identical(healed, frame)
+    assert spill.read_bytes() == good
+    assert obs_metrics.counter_value("durable.read_repair") == 1
+    assert obs_metrics.counter_value("durable.spills_rejected") == 0
+    # second load: clean local serve, no second repair
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(rootA)):
+        j2 = durable.open_run("f" * 64, "test")
+        assert j2.load_pass(0, 0) is not None
+    assert obs_metrics.counter_value("durable.read_repair") == 1
+    obs_metrics.reset()
+
+
+def test_read_repair_without_peers_is_prior_behavior(tmp_path, peerless,
+                                                     no_live_journal):
+    """RF=1 / no fleet attached: the PR-19 contract exactly — a bad
+    spill is rejected (counted), the record drops, the pass re-executes.
+    No repair traffic, no new counters."""
+    _mk_run(tmp_path)
+    _flip_byte(tmp_path / ("f" * 64) / "pass_L0_P0.arrow")
+    obs_metrics.reset()
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path),
+                         CYLON_TPU_DURABLE_RF="1"):
+        j = durable.open_run("f" * 64, "test")
+        assert j.load_pass(0, 0) is None
+        assert j.load_pass(0, 1) is not None
+    assert obs_metrics.counter_value("durable.spills_rejected") == 1
+    assert obs_metrics.counter_value("durable.read_repair") == 0
+    assert obs_metrics.counter_value("durable.read_repair_failed") == 0
+    assert durable._REPLICATION_GUARD is None
+    obs_metrics.reset()
+
+
+_READ_REPAIR_WORKER_SRC = """\
+import os, sys
+root, host, port = sys.argv[1], sys.argv[2], int(sys.argv[3])
+os.environ["CYLON_TPU_DURABLE_DIR"] = root
+import numpy as np
+from cylon_tpu import durable, durable_sync
+durable_sync.set_peers([(host, port)])
+j = durable.open_run("f" * 64, "test")
+loaded = j.load_pass(0, 0)
+assert loaded is not None, "cross-process read-repair failed"
+frame, rows = loaded
+np.save(sys.argv[4], frame["v"].view(np.uint8))
+print("repaired", rows)
+"""
+
+
+def test_read_repair_across_processes(tmp_path, no_live_journal):
+    """Two REAL processes: this one serves its journal over TCP, a
+    fresh process with a bitrotted root heals its load from us and
+    produces byte-identical column bits."""
+    rootA, rootB = tmp_path / "a", tmp_path / "b"
+    frame = _mk_run(rootA)
+    _mk_run(rootB)
+    _flip_byte(rootA / ("f" * 64) / "pass_L0_P0.arrow")
+    srv = durable_sync.JournalPeerServer(str(rootB))
+    out = tmp_path / "healed.npy"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("CYLON_TPU_DURABLE_DIR", None)
+    env.pop("CYLON_TPU_FAULT_PLAN", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _READ_REPAIR_WORKER_SRC, str(rootA),
+             srv.address[0], str(srv.address[1]), str(out)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    finally:
+        srv.close()
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "repaired" in proc.stdout
+    np.testing.assert_array_equal(np.load(out),
+                                  frame["v"].view(np.uint8))
+
+
+_SYNC_PARTIAL_WORKER_SRC = """\
+import sys
+from cylon_tpu import durable_sync
+host, port, root, fp = sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
+ok = durable_sync.pull_run((host, port), root, fp)
+print("pulled", ok)
+"""
+
+
+@pytest.mark.fault
+def test_sync_partial_kill_is_invisible_then_converges(tmp_path,
+                                                       no_live_journal):
+    """sync_partial fault kind: a replication pull killed hard mid-copy
+    (manifest not yet written) leaves NOTHING visible — no manifest, no
+    run in the inventory — and a clean re-pull converges bit-identical."""
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    frame = _mk_run(src, passes=3)
+    os.makedirs(dst, exist_ok=True)
+    srv = durable_sync.JournalPeerServer(str(src))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CYLON_TPU_FAULT_PLAN"] = "journal_sync_file@2=sync_partial"
+    env.pop("CYLON_TPU_DURABLE_DIR", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SYNC_PARTIAL_WORKER_SRC,
+             srv.address[0], str(srv.address[1]), str(dst), "f" * 64],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 137, (proc.returncode, proc.stderr[-2000:])
+        # mid-copy kill: spills may exist, the manifest must NOT — the
+        # half-copied dir is an orphan: no digest advertised, no run
+        # visible to open_run/replication (scan_runs still counts its
+        # BYTES, deliberately, so GC pressure accounting sees them)
+        run_dir = dst / ("f" * 64)
+        assert not os.path.exists(run_dir / durable.MANIFEST)
+        assert durable.read_manifest(str(run_dir)) is None
+        assert durable.journal_digests(str(dst)) == {}
+        # convergence: a clean re-pull completes and loads bit-identical
+        assert durable_sync.pull_run(srv.address, str(dst), "f" * 64)
+    finally:
+        srv.close()
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(dst)):
+        j = durable.open_run("f" * 64, "test")
+        assert j.completed_count() == 3
+        loaded, rows = j.load_pass(0, 0)
+    _assert_bit_identical(loaded, frame)
+
+
+@pytest.mark.fault
+def test_bitrot_fault_kind_rejected_then_bit_identical(rng, tmp_path):
+    """bitrot fault kind end to end: one committed spill byte flips
+    mid-run; the NEXT invocation rejects exactly that record and the
+    replay still completes bit-identical to the oracle."""
+    left, right = _join_inputs(rng)
+    base, _ = _run(left, right)
+    with config.knob_env(CYLON_TPU_DURABLE_DIR=str(tmp_path)):
+        with resilience.fault_plan("journal_commit@2=bitrot") as p:
+            r1, s1 = _run(left, right)
+        assert p.fired == [("journal_commit", "bitrot", 2)]
+        obs_metrics.reset()
+        r2, s2 = _run(left, right)
+    assert obs_metrics.counter_value("durable.spills_rejected") == 1
+    assert s2["passes_skipped"] == s2["passes"] - 1
+    _assert_bit_identical(r1, base)
+    _assert_bit_identical(r2, base)
+    obs_metrics.reset()
+
+
+_RESTORE_WORKER_SRC = """\
+import os, sys
+host, port, root = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["CYLON_TPU_DURABLE_DIR"] = root
+import numpy as np
+from cylon_tpu import durable, durable_sync
+stats = durable_sync.journal_restore(root, [(host, port)])
+assert stats["pulled"] >= 1 and stats["failed"] == 0, stats
+j = durable.open_run("f" * 64, "test")
+assert j.completed_count() == 2, j.completed_count()
+frame, rows = j.load_pass(0, 0)
+np.save(sys.argv[4], frame["v"].view(np.uint8))
+print("restored", stats["pulled"])
+"""
+
+
+def test_journal_restore_rebuilds_empty_root(tmp_path, no_live_journal):
+    """Disaster recovery in a FRESH process: an empty journal root is
+    rebuilt whole from a peer and immediately serves bit-identical
+    passes — the rebuilt journal is a journal, not a copy of files."""
+    src, dst = tmp_path / "src", tmp_path / "empty"
+    frame = _mk_run(src)
+    srv = durable_sync.JournalPeerServer(str(src))
+    out = tmp_path / "restored.npy"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("CYLON_TPU_DURABLE_DIR", None)
+    env.pop("CYLON_TPU_FAULT_PLAN", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _RESTORE_WORKER_SRC, srv.address[0],
+             str(srv.address[1]), str(dst), str(out)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    finally:
+        srv.close()
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "restored" in proc.stdout
+    np.testing.assert_array_equal(np.load(out),
+                                  frame["v"].view(np.uint8))
+
+
+def test_gc_respects_replication_guard(tmp_path, rng, no_live_journal):
+    """gc_journal never evicts a run the coordinator still counts toward
+    the replication factor: the guarded LRU victim is spared (counted),
+    the next-LRU run goes instead; clearing the guard restores PR-16."""
+    _journal_runs(tmp_path, rng, k=3)
+    _stagger_lru(durable.scan_runs(str(tmp_path)))
+    inv = durable.scan_runs(str(tmp_path))
+    victim = inv[0]["fingerprint"]
+    total = sum(r["bytes"] for r in inv)
+    durable.set_gc_replication_guard(lambda fp: fp == victim)
+    obs_metrics.reset()
+    try:
+        evicted, _ = durable.gc_journal(str(tmp_path), cap=total - 1)
+    finally:
+        durable.set_gc_replication_guard(None)
+    assert evicted == 1
+    assert obs_metrics.counter_value("durable.gc_skipped_replication") == 1
+    survivors = {r["fingerprint"] for r in durable.scan_runs(str(tmp_path))}
+    assert victim in survivors
+    assert inv[1]["fingerprint"] not in survivors
+    obs_metrics.reset()
+
+
+def test_run_digest_identity_and_digest_inventory(tmp_path,
+                                                  no_live_journal):
+    """run_digest: equal committed content -> equal digest across
+    DIFFERENT roots; a content change flips it; journal_digests
+    inventories every readable run."""
+    rootA, rootB = tmp_path / "a", tmp_path / "b"
+    _mk_run(rootA)
+    _mk_run(rootB)
+    da = durable.run_digest(str(rootA / ("f" * 64)))
+    db = durable.run_digest(str(rootB / ("f" * 64)))
+    assert da is not None and da["complete"] and da["passes"] == 2
+    assert da["digest"] == db["digest"]
+    _mk_run(rootB, fp="9" * 64, passes=1, n=8)
+    dc = durable.run_digest(str(rootB / ("9" * 64)))
+    assert dc["digest"] != da["digest"]
+    inv = durable.journal_digests(str(rootB))
+    assert set(inv) == {"f" * 64, "9" * 64}
+    # an orphan (manifest-less) dir is invisible to the inventory
+    os.makedirs(rootB / ("0" * 64), exist_ok=True)
+    assert set(durable.journal_digests(str(rootB))) == set(inv)
+
+
+def test_coordinator_journal_reply_placement():
+    """The anti-entropy placement math, unit-level: guards only
+    load-bearing copies (holders < RF), assigns exactly RF - holders
+    pullers deterministically, counts DISTINCT roots (shared-filesystem
+    replicas are one copy), and goes quiet at RF=1."""
+    from cylon_tpu import elastic
+
+    coord = elastic.Coordinator(world=3)
+    fp = "a" * 64
+    rec = {"digest": "d1", "complete": True, "pinned": False,
+           "passes": 2, "bytes": 100}
+    coord._last_hb = {0: 0.0, 1: 0.0, 2: 0.0}
+    coord._telemetry = {
+        0: {"journal": {"addr": ["h0", 1], "root": "/r0",
+                        "digests": {fp: rec}}},
+        1: {"journal": {"addr": ["h1", 2], "root": "/r1", "digests": {}}},
+        2: {"journal": {"addr": ["h2", 3], "root": "/r2", "digests": {}}},
+    }
+    with config.knob_env(CYLON_TPU_DURABLE_RF="2"):
+        holder = coord._journal_reply_locked(0)
+        puller = coord._journal_reply_locked(1)
+        spare = coord._journal_reply_locked(2)
+    # the only copy is load-bearing: guarded on the holder, hinted to
+    # exactly the FIRST non-holder rank, nothing for the spare
+    assert holder["journal_guard"] == [fp]
+    assert "journal_sync" not in holder
+    assert puller["journal_sync"] == [
+        {"fingerprint": fp, "from": ["h0", 1], "pinned": False}]
+    assert "journal_sync" not in spare and "journal_guard" not in spare
+    assert set(puller["journal_peers"]) == {"0", "2"}
+    # rank 1 now holds a copy too: replicated to target -> no guard, no
+    # hints, GC free to evict either copy
+    coord._telemetry[1]["journal"]["digests"] = {fp: dict(rec)}
+    with config.knob_env(CYLON_TPU_DURABLE_RF="2"):
+        assert "journal_guard" not in coord._journal_reply_locked(0)
+        assert "journal_sync" not in coord._journal_reply_locked(2)
+    # shared root: two ranks advertising ONE realpath are one copy
+    coord._telemetry[1]["journal"]["root"] = "/r0"
+    with config.knob_env(CYLON_TPU_DURABLE_RF="2"):
+        assert coord._journal_reply_locked(0)["journal_guard"] == [fp]
+        assert coord._journal_reply_locked(2)["journal_sync"][0][
+            "fingerprint"] == fp
+    # RF=1: anti-entropy off — no guards, no hints, ever
+    with config.knob_env(CYLON_TPU_DURABLE_RF="1"):
+        r0 = coord._journal_reply_locked(0)
+        assert "journal_guard" not in r0 and "journal_sync" not in r0
+    # a dead rank's advertisement stops counting
+    coord._telemetry[1]["journal"]["root"] = "/r1"
+    coord._telemetry[1]["journal"]["digests"] = {}
+    coord._dead[1] = "fenced"
+    with config.knob_env(CYLON_TPU_DURABLE_RF="2"):
+        assert set(coord._journal_reply_locked(0)["journal_peers"]) == {"2"}
+
+
+def test_fleet_anti_entropy_converges(tmp_path, no_live_journal):
+    """The tentpole, in-process: two replicas with DISTINCT journal
+    roots heartbeat a real coordinator; the run only root 0 holds is
+    hinted to root 1 over the beats and arrives complete, loadable and
+    bit-identical — no direct wiring between the replicas."""
+    from cylon_tpu import elastic
+
+    roots = [tmp_path / "r0", tmp_path / "r1"]
+    frame = _mk_run(roots[0], fp="a" * 64)
+    os.makedirs(roots[1], exist_ok=True)
+    coord = elastic.Coordinator(world=2, heartbeat_timeout_s=2.0).start()
+    addr = f"{coord.address[0]}:{coord.address[1]}"
+    servers, syncers, agents = [], [], []
+    try:
+        for r in range(2):
+            srv = durable_sync.JournalPeerServer(str(roots[r]))
+            sy = durable_sync.JournalSyncer(str(roots[r]))
+            a = elastic.Agent(addr, r, interval_s=0.05, timeout_s=2.0)
+
+            def tel(sy=sy, srv=srv):
+                j = sy.telemetry()
+                j["addr"] = list(srv.address)
+                return {"journal": j}
+
+            a.attach_telemetry(tel)
+            a.attach_journal_sync(sy.on_heartbeat)
+            a.start()
+            servers.append(srv)
+            syncers.append(sy)
+            agents.append(a)
+        deadline = time.time() + 30
+        target = roots[1] / ("a" * 64) / durable.MANIFEST
+        while time.time() < deadline and not os.path.exists(target):
+            time.sleep(0.05)
+        assert os.path.exists(target), "anti-entropy never converged"
+        # while under-replicated the holder's GC guard was installed;
+        # after convergence the run loads bit-identical from root 1
+        with config.knob_env(CYLON_TPU_DURABLE_DIR=str(roots[1])):
+            j = durable.open_run("a" * 64, "test")
+            assert j.completed_count() == 2
+            loaded, rows = j.load_pass(0, 0)
+        _assert_bit_identical(loaded, frame)
+    finally:
+        for a in agents:
+            a.stop()
+        for s in syncers:
+            s.close()
+        for s in servers:
+            s.close()
+        coord.stop()
+    assert durable._REPLICATION_GUARD is None, "syncer close left a guard"
+
+
+# ---------------------------------------------------------------------------
+# tools/journal_fsck.py: the offline scrubber twin's rc contract
+# ---------------------------------------------------------------------------
+
+def _fsck(*args):
+    env = dict(os.environ)
+    env.pop("CYLON_TPU_DURABLE_DIR", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "journal_fsck.py"),
+         *map(str, args)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_journal_fsck_rc_contract(tmp_path, no_live_journal):
+    """rc 0 clean / 1 repaired / 2 quarantined / 3 unreadable, busy
+    lease backs off at rc 0 — stdlib-only (no package import)."""
+    root = tmp_path / "root"
+    _mk_run(root)
+    # clean (and --json reports it)
+    proc = _fsck(root, "--json")
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["clean"] == 1 and report["checked"] == 2
+    # torn manifest tail is clean by contract
+    mani = root / ("f" * 64) / durable.MANIFEST
+    mani.write_text(mani.read_text() + '{"kind": "pa')
+    assert _fsck(root).returncode == 0
+    # repaired from a peer
+    peer_root = tmp_path / "peer"
+    _mk_run(peer_root)
+    spill = root / ("f" * 64) / "pass_L0_P0.arrow"
+    good = spill.read_bytes()
+    _flip_byte(spill)
+    srv = durable_sync.JournalPeerServer(str(peer_root))
+    try:
+        proc = _fsck(root, "--repair-from",
+                     f"{srv.address[0]}:{srv.address[1]}")
+    finally:
+        srv.close()
+    assert proc.returncode == 1, proc.stderr
+    assert spill.read_bytes() == good
+    # quarantined without a peer
+    _flip_byte(spill)
+    proc = _fsck(root)
+    assert proc.returncode == 2, proc.stderr
+    assert not os.path.exists(root / ("f" * 64))
+    # a damaged PINNED run is kept standing but still rc 2
+    _mk_run(root, fp="9" * 64, pin=True)
+    _flip_byte(root / ("9" * 64) / "pass_L0_P0.arrow")
+    proc = _fsck(root, "--json")
+    assert proc.returncode == 2
+    assert json.loads(proc.stdout)["kept_damaged"] == 1
+    assert os.path.exists(root / ("9" * 64) / durable.MANIFEST)
+    # busy lease: clean back-off, nothing touched
+    lock = root / durable.GC_LOCK
+    lock.write_text("{}")
+    proc = _fsck(root)
+    assert proc.returncode == 0
+    assert "retry" in proc.stdout
+    lock.unlink()
+    # unreadable root
+    assert _fsck(root / "nope").returncode == 3
+
+
+def test_wire_blob_digest_contract():
+    """blob_b64/blob_from_b64: bit-exact round trip, transfer-damage
+    refusal, and divergence-from-local-manifest refusal."""
+    from cylon_tpu.router import wire
+
+    data = bytes(range(256)) * 3
+    d = wire.blob_b64(data)
+    assert wire.blob_from_b64(d) == data
+    sha = d["sha256"]
+    assert wire.blob_from_b64(d, expect_sha=sha) == data
+    with pytest.raises(CylonError) as ei:
+        wire.blob_from_b64(dict(d, sha256="0" * 64))
+    assert ei.value.code == Code.IOError
+    with pytest.raises(CylonError) as ei:
+        wire.blob_from_b64(d, expect_sha="0" * 64)
+    assert ei.value.code == Code.IOError
+    assert "diverges" in ei.value.msg
+    with pytest.raises(CylonError) as ei:
+        wire.blob_b64("not bytes")
+    assert ei.value.code == Code.SerializationError

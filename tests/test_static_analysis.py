@@ -476,8 +476,11 @@ def test_cy110_arrow_ipc_decode_is_a_host_only_barrier(tmp_path):
         def _handle_submit(req):
             return jax.device_put(frame_from_ipc_bytes(req["payload"]))
         """, extra=[arrow])
-    assert [f.rule for f in found] == ["CY110"]
-    assert "device_put" in found[0].msg
+    # (the unverified decode also draws CY117 — this test is about the
+    # host-only barrier, so assert on the CY110 set alone)
+    cy110 = [f for f in found if f.rule == "CY110"]
+    assert [f.rule for f in cy110] == ["CY110"]
+    assert "device_put" in cy110[0].msg
 
 
 def test_cy111_rpc_under_placement_lock(tmp_path):
@@ -751,6 +754,87 @@ def test_cy116_only_fires_under_the_stream_package(tmp_path):
             return journal.load_pass(0, 0)
         """)
     assert "CY116" not in {f.rule for f in found}
+
+
+# ---------------------------------------------------------------------------
+# CY117: spill bytes read outside a checksum-verifying loader (PR 20)
+# ---------------------------------------------------------------------------
+
+def _scan_pkg(tmp_path, src, name="durable_helper.py"):
+    """CY117 fixtures must resolve INTO the package namespace (the rule
+    only polices cylon_tpu code, not user scripts)."""
+    d = tmp_path / "cylon_tpu"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(src))
+    return astlint.scan_paths([str(p)])
+
+
+def test_cy117_raw_binary_spill_read_fires(tmp_path):
+    # the PR-20 bug class: a new code path reads committed .arrow bytes
+    # straight off disk — silent bitrot would be served as truth instead
+    # of triggering read-repair or quarantine
+    found = _scan_pkg(tmp_path, """\
+        import os
+
+        def read_spill(run_dir, level, part):
+            path = os.path.join(run_dir, f"pass_L{level}_P{part}.arrow")
+            with open(path, "rb") as fh:
+                return fh.read()
+        """)
+    assert [(f.rule, f.line) for f in found if f.rule == "CY117"] \
+        == [("CY117", 3)]
+    assert "bitrot" in found[0].msg
+
+
+def test_cy117_sha256_verified_read_is_clean(tmp_path):
+    found = _scan_pkg(tmp_path, """\
+        import hashlib, os
+
+        def read_spill(run_dir, entry):
+            path = os.path.join(run_dir, entry["file"])  # a .arrow spill
+            with open(path, "rb") as fh:
+                data = fh.read()
+            if hashlib.sha256(data).hexdigest() != entry["sha256"]:
+                raise IOError("spill corrupt")
+            return data
+        """)
+    assert "CY117" not in {f.rule for f in found}
+
+
+def test_cy117_unverified_ipc_decode_fires_and_loader_is_clean(tmp_path):
+    # frame_from_ipc_bytes on unverified bytes is the same hazard with
+    # the open() hidden behind a helper; going through the journal's
+    # verifying loader (load_pass) is the sanctioned path
+    found = _scan_pkg(tmp_path, """\
+        from cylon_tpu.io.arrow_io import frame_from_ipc_bytes
+
+        def decode(blob):
+            return frame_from_ipc_bytes(blob)
+
+        def sanctioned(journal, part):
+            return journal.load_pass(0, part)
+        """)
+    assert [(f.rule, f.line) for f in found if f.rule == "CY117"] \
+        == [("CY117", 3)]
+    assert "frame_from_ipc_bytes" in found[0].msg
+
+
+def test_cy117_outside_package_and_write_mode_are_out_of_scope(tmp_path):
+    # a user script is not package code, and a binary WRITE of a spill
+    # (the journal's own commit path hashes what it writes) never fires
+    src = """\
+        def read_spill(path):
+            with open(path + ".arrow", "rb") as fh:
+                return fh.read()
+        """
+    assert "CY117" not in {f.rule for f in _scan(tmp_path, src)}
+    found = _scan_pkg(tmp_path, """\
+        def write_spill(run_dir, name, data):
+            with open(run_dir + "/" + name + ".arrow", "wb") as fh:
+                fh.write(data)
+        """)
+    assert "CY117" not in {f.rule for f in found}
 
 
 _CY109_BUILDER = """\

@@ -1144,6 +1144,292 @@ if [ $rc -ne 0 ]; then
   echo "streaming smoke failed (rc=$rc); fix streaming ingestion before the full tree" >&2
   exit $rc
 fi
+# self-healing journal chaos smoke (ISSUE-20): the 2-replica fleet with
+# PER-REPLICA journal roots at RF=2 and replica 0's scrubber armed; after
+# a 12-request flood anti-entropy must converge both roots to the same
+# run inventory, then the driver flips bytes in committed spills on BOTH
+# roots — replica 0's scrubber repairs its copy from the peer
+# (scrub_repaired >= 1, asserted from its metrics artifact) while replica
+# 1 (scrubber off) heals lazily through read-repair during replays
+# (read_repair >= 1), every serve staying bit-identical with zero
+# failures; journal_fsck must then find both roots clean (rc 0), and a
+# disaster-wiped root rebuilt by journal_restore must replay a cached run
+# whole (passes_skipped == passes, plan_cache.miss == 0)
+JS=$(mktemp -d /tmp/cylon_journal_smoke.XXXXXX)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python - "$JS" <<'PYEOF'
+import hashlib, json, os, subprocess, sys, threading, time
+
+sys.path.insert(0, os.getcwd())
+os.environ.pop("CYLON_TPU_DURABLE_DIR", None)   # driver oracles stay
+os.environ.pop("CYLON_TPU_FAULT_PLAN", None)    # journal-off, fault-free
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from cylon_tpu import config, durable, durable_sync, elastic
+from cylon_tpu.exec import chunked_join
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.router import QueryRouter, RouterClient
+
+td = sys.argv[1]
+j0, j1 = os.path.join(td, "j0"), os.path.join(td, "j1")
+router = QueryRouter(world=3, heartbeat_timeout_s=2.5).start()
+addr = f"{router.address[0]}:{router.address[1]}"
+base_env = {k: v for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS",
+                         "CYLON_TPU_FAULT_PLAN")}
+base_env.update(CYLON_TPU_HEARTBEAT_S="0.1",
+                CYLON_TPU_HEARTBEAT_TIMEOUT_S="2.5",
+                CYLON_TPU_COORD_RECONNECT_S="0",
+                CYLON_TPU_DURABLE_RF="2",
+                CYLON_TPU_TRACE_DIR=os.path.join(td, "traces"))
+procs = []
+for r in range(2):
+    env = dict(base_env)
+    env["CYLON_TPU_DURABLE_DIR"] = (j0, j1)[r]
+    if r == 0:
+        # the deterministic split: replica 0 heals by SCRUB, replica 1
+        # (no scrubber) only by read-repair during a replayed serve
+        env["CYLON_TPU_SCRUB_S"] = "0.5"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "tests.router_worker", str(r), "3", addr],
+        env=env))
+
+
+def digests(root):
+    return {fp: rec["digest"]
+            for fp, rec in durable.journal_digests(root).items()}
+
+
+def first_entry(root, fp):
+    m = durable.read_manifest(os.path.join(root, fp))
+    return m["passes"][sorted(m["passes"])[0]]
+
+
+def flip(root, fp):
+    """Flip one byte mid-spill; returns (path, manifest sha) to poll."""
+    e = first_entry(root, fp)
+    path = os.path.join(root, fp, str(e["file"]))
+    with open(path, "r+b") as fh:
+        fh.seek(os.path.getsize(path) // 2)
+        b = fh.read(1)
+        fh.seek(-1, 1)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    return path, e["sha256"]
+
+
+def sha(path):
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as fh:
+            for c in iter(lambda: fh.read(1 << 20), b""):
+                h.update(c)
+    except OSError:
+        # mid-heal window: quarantine evicted the run and the
+        # anti-entropy re-pull has not landed the file yet
+        return None
+    return h.hexdigest()
+
+
+summary = {}
+try:
+    agent = elastic.Agent(addr, 2, interval_s=0.1, timeout_s=2.5,
+                          reconnect_s=0.0).start()
+    deadline = time.monotonic() + 120
+    while router.router_status()["replicas_live"] < 2:
+        assert time.monotonic() < deadline, "replicas never registered"
+        time.sleep(0.1)
+    cli = RouterClient(addr)
+
+    def mk(seed):
+        rg = np.random.default_rng(seed)
+        n = 1200
+        return ({"k": rg.integers(0, n, n).astype(np.int64),
+                 "a": rg.random(n).astype(np.float32)},
+                {"k": rg.integers(0, n, n).astype(np.int64),
+                 "b": rg.random(n).astype(np.float32)})
+
+    inputs = [mk(300 + i) for i in range(4)]
+    oracles = [chunked_join(l, r, on="k", passes=2, mode="hash")[0]
+               for l, r in inputs]
+
+    def check(i, res):
+        base = oracles[i % 4]
+        assert set(res) == set(base), i
+        for k in res:
+            a, b = np.asarray(res[k]), np.asarray(base[k])
+            assert a.dtype == b.dtype, (i, k)
+            np.testing.assert_array_equal(a, b, err_msg=f"req {i} col {k}")
+
+    outs, errs, lock = {}, [], threading.Lock()
+
+    def one(i):
+        l, r = inputs[i % 4]
+        try:
+            res, _ = cli.route(f"tenant-{i % 4}", "kjoin", l, r, on="k",
+                               passes=2, mode="hash", timeout_s=300)
+            with lock:
+                outs[i] = res
+        except Exception as e:
+            with lock:
+                errs.append((i, e))
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(12)]
+    for t in threads:
+        t.start()
+        time.sleep(0.05)
+    for t in threads:
+        t.join(360)
+    assert all(not t.is_alive() for t in threads), "a routed request hung"
+    assert not errs, errs
+    for i, res in outs.items():
+        check(i, res)
+    summary["served"] = len(outs)
+    summary["failures"] = len(errs)
+
+    # anti-entropy convergence: RF=2 must drive BOTH roots to the same
+    # run inventory (manifest digests compare equal across roots)
+    deadline = time.monotonic() + 90
+    while True:
+        d0, d1 = digests(j0), digests(j1)
+        if len(d0) >= 2 and d0 == d1:
+            break
+        assert time.monotonic() < deadline, ("anti-entropy never "
+                                             "converged", d0, d1)
+        time.sleep(0.2)
+    summary["replicated_runs"] = len(d0)
+    fps = sorted(d0)
+
+    # seeded bitrot, phase 1 (scrub): flip a spill byte in TWO runs on
+    # replica 0's root with NO requests in flight — only its background
+    # scrubber can heal these, and at most one run is skipped as live,
+    # so at least one heals within a couple of 0.5s rounds
+    scrub_targets = [flip(j0, fp) for fp in fps[:2]]
+    deadline = time.monotonic() + 60
+    while not any(sha(p) == want for p, want in scrub_targets):
+        assert time.monotonic() < deadline, "scrubber never repaired"
+        time.sleep(0.25)
+
+    # seeded bitrot, phase 2 (read-repair): flip a spill byte on replica
+    # 1's root, then replay the flood inputs until every damaged file on
+    # both roots carries its manifest sha again — replica 1 has no
+    # scrubber, so its heal can only come from load-time read-repair,
+    # and the replays that hit the damage must still serve bit-identical
+    rr_path, rr_sha = flip(j1, fps[-1])
+    targets = scrub_targets + [(rr_path, rr_sha)]
+    start = time.monotonic()
+    deadline = start + 120
+    i = 0
+    while not all(sha(p) == want for p, want in targets):
+        assert time.monotonic() < deadline, (
+            "heal stalled", [(p, sha(p) == w) for p, w in targets])
+        if time.monotonic() > start + 20:
+            # a scrub target can stay corrupt only while it is replica
+            # 0's LIVE run (scrub skips under its own writer) and no
+            # replay landed on replica 0 to move the pointer; un-flip it
+            # (the XOR is its own inverse) — scrub_repaired was already
+            # banked on the other target in phase 1
+            for p, want in scrub_targets:
+                if sha(p) not in (want, None):
+                    with open(p, "r+b") as fh:
+                        fh.seek(os.path.getsize(p) // 2)
+                        b = fh.read(1)
+                        fh.seek(-1, 1)
+                        fh.write(bytes([b[0] ^ 0xFF]))
+        l, r = inputs[i % 4]
+        res, _ = cli.route(f"tenant-{i % 4}", "kjoin", l, r, on="k",
+                           passes=2, mode="hash", timeout_s=300)
+        check(i, res)
+        i += 1
+        time.sleep(0.2)
+    summary["heal_replays"] = i
+finally:
+    router.stop()
+    for p in procs:
+        try:
+            p.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+assert procs[0].returncode == 0, procs[0].returncode
+assert procs[1].returncode == 0, procs[1].returncode
+
+# offline integrity check: both roots must come back CLEAN (rc 0)
+summary["fsck_rc"] = [
+    subprocess.run([sys.executable, "tools/journal_fsck.py", root],
+                   capture_output=True).returncode
+    for root in (j0, j1)]
+
+# disaster recovery: rebuild an empty root whole from a peer journal,
+# then replay a flood run from it — every pass loads from the restored
+# journal (passes_skipped == passes) and nothing recompiles
+restored = os.path.join(td, "restored")
+srv = durable_sync.JournalPeerServer(j1)
+try:
+    summary["restore"] = durable_sync.journal_restore(
+        restored, [srv.address])
+finally:
+    srv.close()
+assert digests(restored) == digests(j1), "restored inventory diverges"
+with config.knob_env(CYLON_TPU_DURABLE_DIR=restored):
+    obs_metrics.reset()
+    res, st = chunked_join(inputs[0][0], inputs[0][1], on="k", passes=2,
+                           mode="hash")
+check(0, res)
+summary["restore_replay"] = {
+    "passes": st["passes"],
+    "passes_skipped": st.get("passes_skipped", 0),
+    "parts_run": st.get("parts_run", 0),
+    "plan_cache_miss": int(obs_metrics.counter_value("plan_cache.miss")),
+}
+with open(f"{td}/summary.json", "w") as fh:
+    json.dump(summary, fh, indent=1, sort_keys=True)
+print(f"journal chaos smoke: {summary['served']}/12 bit-identical, "
+      f"{summary['replicated_runs']} runs replicated, healed after "
+      f"{summary['heal_replays']} replays, fsck rc={summary['fsck_rc']}")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "journal chaos smoke (run) failed (rc=$rc); fix journal self-healing before the full tree" >&2
+  rm -rf "$JS"; exit $rc
+fi
+python - "$JS" <<'PYEOF'
+import glob, json, sys
+td = sys.argv[1]
+s = json.load(open(f"{td}/summary.json"))
+assert s["served"] == 12 and s["failures"] == 0, s
+assert s["replicated_runs"] >= 2, s
+assert s["fsck_rc"] == [0, 0], s
+assert s["restore"]["pulled"] == s["replicated_runs"], s
+assert s["restore"]["failed"] == 0, s
+rr = s["restore_replay"]
+assert rr["passes_skipped"] == rr["passes"] and rr["parts_run"] == 0, rr
+assert rr["plan_cache_miss"] == 0, rr
+
+
+def counters(rank):
+    paths = sorted(glob.glob(f"{td}/traces/metrics*.r{rank}.json"))
+    assert paths, f"no metrics artifact for rank {rank}"
+    return json.load(open(paths[-1]))["counters"]
+
+
+m0, m1 = counters(0), counters(1)
+assert m0.get("durable.scrub_repaired", 0) >= 1, m0
+assert m1.get("durable.read_repair", 0) >= 1, m1
+assert m0.get("durable.read_repair_failed", 0) == 0, m0
+assert m1.get("durable.read_repair_failed", 0) == 0, m1
+print(f"journal chaos smoke ok: replica 0 scrub-repaired "
+      f"{int(m0['durable.scrub_repaired'])} run(s), replica 1 "
+      f"read-repaired {int(m1['durable.read_repair'])} spill(s), both "
+      f"roots fsck-clean, restore replayed {rr['passes']} passes with 0 "
+      f"plan-cache misses")
+PYEOF
+rc=$?
+rm -rf "$JS"
+if [ $rc -ne 0 ]; then
+  echo "journal chaos smoke (artifact) failed (rc=$rc); fix journal self-healing before the full tree" >&2
+  exit $rc
+fi
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     CYLON_TEST_NO_COMPILE_CACHE=1 PYTHONFAULTHANDLER=1 \
     timeout 14400 python -m pytest tests/ -q -p no:cacheprovider -x \

@@ -1,0 +1,402 @@
+#!/usr/bin/env python3
+"""Offline integrity checker for a cylon_tpu durable-journal root.
+
+The command-line twin of the in-process scrubber
+(``cylon_tpu.durable_sync.scrub_once``): walks every run dir under
+ROOT, re-parses each manifest with the journal's torn-tail rules and
+re-hashes every committed spill against its recorded sha256, then
+
+- **repairs** a damaged spill from a peer journal when ``--repair-from
+  host:port`` names one holding a matching copy (fetched over the
+  replica's read-only journal data plane, digest-verified twice:
+  against the transfer digest AND this root's own manifest entry,
+  installed tmp+fsync+rename);
+- **quarantines** a run whose damage cannot be healed — spills removed
+  first, the manifest LAST, exactly `durable._evict_run_dir`'s order,
+  so a crash mid-quarantine still never leaves a manifest pointing at
+  trusted-looking garbage.  ``PINNED`` runs are never evicted: their
+  damaged passes are reported and left to re-execute at load time;
+- leaves **torn tails** standing (the expected shape of a crash
+  mid-append — everything before the tear is valid by contract) and
+  reports manifest-less **orphan** dirs without touching them (a
+  replication pull in flight looks exactly like this, by design).
+
+Live-root safe: the walk runs under the shared advisory walker lease
+(``GC_LOCK`` — the same lease the GC sweep and the scrubber take), and
+every quarantine re-reads the manifest mtime under the lease, skipping
+runs a live journal freshened since the scan.  When another walker
+holds the lease the tool prints a clean retry message and exits 0.
+
+Exit codes::
+
+    0  clean (or lease busy — nothing inspected, retry later)
+    1  damage found and every damaged spill repaired from a peer
+    2  damage quarantined (or left standing in a PINNED run)
+    3  ROOT unreadable / not a journal root
+
+Pure stdlib on purpose — ``import cylon_tpu`` drags in jax, and this
+tool must run on a recovery box with nothing but CPython.  The lease
+implementation is loaded from ``cylon_tpu/durable_lease.py`` BY FILE
+PATH (itself stdlib-only; the ``tools/trace_report.py`` idiom), so the
+TTL/stale-break semantics can never drift from the in-process walkers.
+
+Usage:
+    python tools/journal_fsck.py ROOT [--repair-from HOST:PORT ...]
+                                 [--json] [--verbose]
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import contextlib
+import hashlib
+import importlib.util
+import json
+import os
+import socket
+import sys
+from typing import Dict, List, Optional, Tuple
+
+MANIFEST = "MANIFEST.jsonl"
+PINNED = "PINNED"
+_FETCH_TIMEOUT_S = 30.0
+_FETCH_MAX_LINE = 64 << 20  # the data-plane default (router_max_line)
+
+
+def _load_lease_module():
+    """Load the shared stdlib-only lease helper by file path — the one
+    implementation behind GC, scrubber and this tool."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "cylon_tpu", "durable_lease.py")
+    spec = importlib.util.spec_from_file_location("_journal_lease", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# manifest parse (duplicates durable.read_manifest's torn-tail rules so
+# the tool stays package-import-free; the contract is pinned by tests)
+# ---------------------------------------------------------------------------
+
+def read_manifest(d: str) -> Optional[Dict]:
+    path = os.path.join(d, MANIFEST)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            raw_lines = fh.read().splitlines()
+    except OSError:
+        return None
+    out = {"header": None, "passes": {}, "done": False,
+           "torn_tail": False, "midline_corrupt": False}
+    bad_seen = False
+    for raw in raw_lines:
+        try:
+            entry = json.loads(raw)
+            if not isinstance(entry, dict):
+                raise ValueError("manifest line is not an object")
+        except ValueError:
+            bad_seen = True
+            out["torn_tail"] = True
+            continue
+        if bad_seen:
+            # a parseable line AFTER an unparseable one: impossible under
+            # the fsync'd append-only discipline -> bitrot inside
+            # committed history, not a crash tail
+            out["midline_corrupt"] = True
+            out["torn_tail"] = False
+            break
+        kind = entry.get("kind")
+        if kind == "run":
+            out["header"] = entry
+        elif kind == "pass":
+            try:
+                out["passes"][(int(entry["level"]),
+                               int(entry["part"]))] = entry
+            except (KeyError, TypeError, ValueError):
+                out["midline_corrupt"] = True
+                break
+        elif kind == "done":
+            out["done"] = True
+    return out
+
+
+def _verify_spill(d: str, entry: Dict) -> Optional[str]:
+    """None when the spill matches its manifest sha256, else a reason."""
+    name = entry.get("file")
+    if not isinstance(name, str) or not name:
+        return "manifest pass entry names no file"
+    path = os.path.join(d, name)
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+    except OSError as e:
+        return f"unreadable ({type(e).__name__})"
+    if h.hexdigest() != entry.get("sha256"):
+        return "sha256 mismatch"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# peer repair (speaks the replica's journal data plane: one JSON line
+# per TCP connection, the net/control.py framing)
+# ---------------------------------------------------------------------------
+
+def _rpc(addr: Tuple[str, int], obj: Dict,
+         timeout: float = _FETCH_TIMEOUT_S) -> Dict:
+    with socket.create_connection(addr, timeout=timeout) as sk:
+        sk.settimeout(timeout)
+        sk.sendall(json.dumps(obj, sort_keys=True).encode() + b"\n")
+        buf = bytearray()
+        while not buf.endswith(b"\n"):
+            chunk = sk.recv(65536)
+            if not chunk:
+                raise ConnectionError("journal peer closed mid-message")
+            buf.extend(chunk)
+            if len(buf) > _FETCH_MAX_LINE:
+                raise ConnectionError("journal peer reply exceeds the "
+                                      "data-plane line cap")
+    return json.loads(buf.decode())
+
+
+def fetch_spill(addr: Tuple[str, int], fingerprint: str, file: str,
+                expect_sha: str) -> bytes:
+    """One spill's bytes from a peer, verified against the transfer
+    digest AND this root's own manifest sha256 — a diverged peer is as
+    refused as a torn transfer."""
+    resp = _rpc(addr, {"cmd": "journal_fetch", "fingerprint": fingerprint,
+                       "file": file})
+    if not resp.get("ok"):
+        err = (resp.get("error") or {})
+        raise ConnectionError(f"peer refused journal_fetch: "
+                              f"{err.get('code')}: {err.get('msg')}")
+    data = base64.b64decode(resp["blob"])
+    digest = hashlib.sha256(data).hexdigest()
+    if digest != resp.get("sha256"):
+        raise ConnectionError("journal blob damaged in transfer")
+    if digest != expect_sha:
+        raise ConnectionError("peer journal blob diverges from the local "
+                              "manifest")
+    return data
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + f".tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
+
+
+def _repair_spill(peers: List[Tuple[str, int]], d: str, fingerprint: str,
+                  entry: Dict, verbose: bool) -> bool:
+    for addr in peers:
+        try:
+            data = fetch_spill(addr, fingerprint, entry["file"],
+                               expect_sha=entry["sha256"])
+        except (OSError, ValueError, KeyError) as e:
+            if verbose:
+                print(f"  repair fetch from {addr[0]}:{addr[1]} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+            continue
+        try:
+            _atomic_write(os.path.join(d, entry["file"]), data)
+            return True
+        except OSError as e:
+            print(f"  repair write of {entry['file']} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return False
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the walk
+# ---------------------------------------------------------------------------
+
+def _evict_run_dir(d: str) -> None:
+    """Spills first, the manifest LAST, then the dir — a crash at any
+    point leaves either checksum-failing spills (passes re-execute) or
+    no manifest at all, never a trusted-looking torn journal."""
+    names: List[str] = []
+    with contextlib.suppress(OSError):
+        names = os.listdir(d)
+    for fn in sorted(names):
+        if fn != MANIFEST:
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(d, fn))
+    with contextlib.suppress(OSError):
+        os.remove(os.path.join(d, MANIFEST))
+    with contextlib.suppress(OSError):
+        os.rmdir(d)
+
+
+def fsck(root: str, peers: List[Tuple[str, int]],
+         verbose: bool = False) -> Dict:
+    """Walk ``root`` under the shared lease; returns the report dict
+    (``rc`` carries the exit-code contract from the module docstring)."""
+    report: Dict = {"root": root, "rc": 0, "busy": False, "runs": 0,
+                    "checked": 0, "clean": 0, "torn": 0, "orphans": 0,
+                    "repaired": 0, "quarantined": 0, "kept_damaged": 0,
+                    "skipped_fresh": 0, "details": []}
+    if not os.path.isdir(root):
+        print(f"journal_fsck: {root}: not a directory", file=sys.stderr)
+        report["rc"] = 3
+        return report
+    try:
+        names = sorted(os.listdir(root))
+    except OSError as e:
+        print(f"journal_fsck: cannot read {root}: {e}", file=sys.stderr)
+        report["rc"] = 3
+        return report
+
+    lease_mod = _load_lease_module()
+    lease = lease_mod.acquire_lease(root)
+    if lease is None:
+        print(f"journal_fsck: another walker (GC / scrubber / fsck) holds "
+              f"the lease on {root}; nothing inspected — retry in a few "
+              f"seconds")
+        report["busy"] = True
+        return report
+    try:
+        for name in names:
+            d = os.path.join(root, name)
+            if not os.path.isdir(d):
+                continue
+            report["runs"] += 1
+            m = read_manifest(d)
+            detail = {"fingerprint": name}
+            if m is None:
+                # no manifest: a replication pull in flight, or the tail
+                # of a crashed eviction — invisible to loads, leave it
+                report["orphans"] += 1
+                detail["state"] = "orphan"
+                report["details"].append(detail)
+                continue
+            try:
+                scan_mtime = os.path.getmtime(os.path.join(d, MANIFEST))
+            except OSError:
+                scan_mtime = None
+            structural = None
+            if m["midline_corrupt"]:
+                structural = "manifest corrupt mid-line"
+            elif m["header"] is not None \
+                    and m["header"].get("fingerprint") != name:
+                structural = (f"foreign manifest (header fingerprint "
+                              f"{str(m['header'].get('fingerprint'))[:12]})")
+            bad: List[Tuple[Dict, str]] = []
+            if structural is None:
+                for key in sorted(m["passes"]):
+                    entry = m["passes"][key]
+                    report["checked"] += 1
+                    why = _verify_spill(d, entry)
+                    if why is not None:
+                        bad.append((entry, why))
+            if m["torn_tail"]:
+                report["torn"] += 1
+                detail["torn_tail"] = True
+            if structural is None and not bad:
+                report["clean"] += 1
+                detail["state"] = "clean"
+                report["details"].append(detail)
+                continue
+
+            detail["damage"] = structural or [
+                f"{e.get('file')}: {why}" for e, why in bad]
+            if structural is None and peers:
+                healed = [e for e, _ in bad
+                          if _repair_spill(peers, d, name, e, verbose)]
+                if len(healed) == len(bad):
+                    report["repaired"] += 1
+                    detail["state"] = "repaired"
+                    report["details"].append(detail)
+                    print(f"journal_fsck: repaired {len(healed)} spill(s) "
+                          f"of run {name[:12]} from peer journal",
+                          file=sys.stderr)
+                    continue
+                bad = [(e, w) for e, w in bad
+                       if e not in healed]  # quarantine what remains
+
+            if os.path.exists(os.path.join(d, PINNED)):
+                # pinned stream state is an explicit retention promise;
+                # the damaged passes re-execute at load, the run stands
+                report["kept_damaged"] += 1
+                detail["state"] = "kept-damaged (PINNED)"
+                report["details"].append(detail)
+                print(f"journal_fsck: run {name[:12]} is damaged but "
+                      f"PINNED; left standing ({len(bad)} bad pass(es) "
+                      f"will re-execute)", file=sys.stderr)
+                continue
+            try:
+                now_mtime = os.path.getmtime(os.path.join(d, MANIFEST))
+            except OSError:
+                now_mtime = None
+            if scan_mtime is None or now_mtime is None \
+                    or now_mtime != scan_mtime:
+                # a live journal appended since we scanned: our parse is
+                # stale — do not destroy on stale evidence
+                report["skipped_fresh"] += 1
+                detail["state"] = "skipped (freshened mid-walk)"
+                report["details"].append(detail)
+                continue
+            _evict_run_dir(d)
+            report["quarantined"] += 1
+            detail["state"] = "quarantined"
+            report["details"].append(detail)
+            print(f"journal_fsck: quarantined run {name[:12]} "
+                  f"({structural or f'{len(bad)} unrepairable spill(s)'})",
+                  file=sys.stderr)
+    finally:
+        lease_mod.release_lease(lease)
+
+    if report["quarantined"] or report["kept_damaged"]:
+        report["rc"] = 2
+    elif report["repaired"]:
+        report["rc"] = 1
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="verify / repair / quarantine a durable-journal root")
+    ap.add_argument("root", help="journal root directory")
+    ap.add_argument("--repair-from", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="peer journal data-plane address to heal damaged "
+                         "spills from (repeatable; tried in order)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON object")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    peers: List[Tuple[str, int]] = []
+    for spec in args.repair_from:
+        host, _, port = spec.rpartition(":")
+        try:
+            peers.append((host or "127.0.0.1", int(port)))
+        except ValueError:
+            ap.error(f"bad --repair-from address {spec!r}")
+
+    report = fsck(args.root, peers, verbose=args.verbose)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    elif not report["busy"] and report["rc"] != 3:
+        print(f"journal_fsck: {report['runs']} run(s), "
+              f"{report['checked']} spill(s) checked: "
+              f"{report['clean']} clean, {report['torn']} torn tail(s), "
+              f"{report['orphans']} orphan dir(s), "
+              f"{report['repaired']} repaired, "
+              f"{report['quarantined']} quarantined, "
+              f"{report['kept_damaged']} kept damaged (PINNED), "
+              f"{report['skipped_fresh']} skipped fresh")
+    return int(report["rc"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
